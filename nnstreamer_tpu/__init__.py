@@ -1,0 +1,19 @@
+"""nnstreamer_tpu — a TPU-native streaming tensor-pipeline framework.
+
+A ground-up re-design of NNStreamer's capabilities (typed tensor streams,
+dataflow pipeline runtime, filter/decoder/converter/trainer subplugins,
+among-device stream fan-out) for TPU: compute is cached jax.jit/XLA
+executables, activations stay HBM-resident across chained elements, custom
+kernels use Pallas, and distribution rides ICI/DCN via jax.sharding instead
+of TCP/MQTT. See SURVEY.md for the reference blueprint.
+"""
+
+__version__ = "0.1.0"
+
+from .tensors import (Buffer, Caps, Chunk, TensorFormat, TensorInfo,
+                      TensorsConfig, TensorsInfo, TensorType)
+
+__all__ = [
+    "Buffer", "Chunk", "Caps", "TensorInfo", "TensorsInfo", "TensorsConfig",
+    "TensorType", "TensorFormat", "__version__",
+]

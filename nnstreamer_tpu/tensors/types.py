@@ -1,0 +1,177 @@
+"""Tensor element types, stream formats, and layouts.
+
+TPU-native re-design of the reference type system
+(ref: gst/nnstreamer/include/tensor_typedef.h:138-226).
+
+Differences from the reference, by design:
+  * ``BFLOAT16`` is added (TPU-native compute dtype; the MXU wants bf16).
+  * Shapes are stored in NumPy/JAX order (outermost-first). The reference's
+    dimension *strings* ("3:224:224:1", innermost-first) are parsed/emitted
+    compatibly by :mod:`nnstreamer_tpu.tensors.info`.
+  * No 16-memory-chunk packing limit: buffers hold a Python list of chunks
+    (ref's NNS_TENSOR_MEMORY_MAX/extra-magic hack in
+    nnstreamer_plugin_api_impl.c:54-91 is a GstBuffer limitation we don't have).
+"""
+from __future__ import annotations
+
+import enum
+
+import numpy as np
+
+try:  # jax optional at import time so the tensor core stays host-usable
+    import jax.numpy as jnp
+
+    _HAS_JAX = True
+except Exception:  # pragma: no cover
+    jnp = None
+    _HAS_JAX = False
+
+# Rank limit matches the reference (tensor_typedef.h:34).
+RANK_LIMIT = 16
+# Max tensors per frame (tensor_typedef.h:42); ours is a soft cap for caps
+# validation only -- buffers are plain lists.
+TENSOR_COUNT_LIMIT = 256
+
+
+class TensorType(enum.IntEnum):
+    """Element dtype of one tensor (ref: tensor_typedef.h:141-153).
+
+    Integer values match the reference enum so serialized streams and
+    protobuf/flatbuf schemas stay interoperable. BFLOAT16 is appended after
+    the reference's last value.
+    """
+
+    INT32 = 0
+    UINT32 = 1
+    INT16 = 2
+    UINT16 = 3
+    INT8 = 4
+    UINT8 = 5
+    FLOAT64 = 6
+    FLOAT32 = 7
+    INT64 = 8
+    UINT64 = 9
+    FLOAT16 = 10
+    BFLOAT16 = 11  # TPU-native extension
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return _NP_DTYPES[self]
+
+    @property
+    def element_size(self) -> int:
+        return _ELEMENT_SIZES[self]
+
+    def __str__(self) -> str:  # caps-string form
+        return _TYPE_NAMES[self]
+
+    @classmethod
+    def from_string(cls, name: str) -> "TensorType":
+        try:
+            return _TYPE_BY_NAME[name.strip().lower()]
+        except KeyError:
+            raise ValueError(f"unknown tensor type {name!r}") from None
+
+    @classmethod
+    def from_dtype(cls, dtype) -> "TensorType":
+        name = np.dtype(dtype).name if str(dtype) != "bfloat16" else "bfloat16"
+        if str(dtype) == "bfloat16":
+            return cls.BFLOAT16
+        try:
+            return _TYPE_BY_NAME[name]
+        except KeyError:
+            raise ValueError(f"unsupported dtype {dtype!r}") from None
+
+
+_TYPE_NAMES = {
+    TensorType.INT32: "int32",
+    TensorType.UINT32: "uint32",
+    TensorType.INT16: "int16",
+    TensorType.UINT16: "uint16",
+    TensorType.INT8: "int8",
+    TensorType.UINT8: "uint8",
+    TensorType.FLOAT64: "float64",
+    TensorType.FLOAT32: "float32",
+    TensorType.INT64: "int64",
+    TensorType.UINT64: "uint64",
+    TensorType.FLOAT16: "float16",
+    TensorType.BFLOAT16: "bfloat16",
+}
+_TYPE_BY_NAME = {v: k for k, v in _TYPE_NAMES.items()}
+
+
+def _bf16_np_dtype():
+    if _HAS_JAX:
+        return jnp.bfloat16
+    try:  # pragma: no cover
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    except Exception:  # pragma: no cover
+        raise RuntimeError("bfloat16 requires jax or ml_dtypes")
+
+
+_NP_DTYPES = {
+    t: np.dtype(_TYPE_NAMES[t])
+    for t in TensorType
+    if t is not TensorType.BFLOAT16
+}
+_NP_DTYPES[TensorType.BFLOAT16] = np.dtype(_bf16_np_dtype()) if _HAS_JAX else None
+
+_ELEMENT_SIZES = {
+    TensorType.INT32: 4,
+    TensorType.UINT32: 4,
+    TensorType.INT16: 2,
+    TensorType.UINT16: 2,
+    TensorType.INT8: 1,
+    TensorType.UINT8: 1,
+    TensorType.FLOAT64: 8,
+    TensorType.FLOAT32: 4,
+    TensorType.INT64: 8,
+    TensorType.UINT64: 8,
+    TensorType.FLOAT16: 2,
+    TensorType.BFLOAT16: 2,
+}
+
+
+class TensorFormat(enum.IntEnum):
+    """Stream data format (ref: tensor_typedef.h:193-200)."""
+
+    STATIC = 0
+    FLEXIBLE = 1
+    SPARSE = 2
+
+    def __str__(self) -> str:
+        return self.name.lower()
+
+    @classmethod
+    def from_string(cls, name: str) -> "TensorFormat":
+        try:
+            return cls[name.strip().upper()]
+        except KeyError:
+            raise ValueError(f"unknown tensor format {name!r}") from None
+
+
+class TensorLayout(enum.IntEnum):
+    """Memory layout hint (ref: tensor_typedef.h:220-226)."""
+
+    ANY = 0
+    NHWC = 1
+    NCHW = 2
+    NONE = 3
+
+
+class MediaType(enum.IntEnum):
+    """Input media types for conversion (ref: tensor_typedef.h:176-187)."""
+
+    INVALID = -1
+    VIDEO = 0
+    AUDIO = 1
+    TEXT = 2
+    OCTET = 3
+    TENSOR = 4
+    ANY = 0x1000
+
+
+# Mimetype string for caps (ref: tensor_typedef.h:97).
+MIMETYPE_TENSORS = "other/tensors"

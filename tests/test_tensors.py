@@ -1,0 +1,178 @@
+"""Tensor core tests (mirrors reference tests/unittest_common.cc scope:
+dimension parse/serialize, info compare, caps round trips, meta headers)."""
+from fractions import Fraction
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.tensors import (Buffer, Caps, Chunk, TensorFormat,
+                                    TensorInfo, TensorMetaInfo, TensorsConfig,
+                                    TensorsInfo, TensorType, parse_dimension,
+                                    serialize_dimension)
+from nnstreamer_tpu.tensors.caps import AltSet, FractionRange, IntRange
+
+
+class TestDimensions:
+    def test_parse_video_dim(self):
+        # reference order: channel:width:height[:batch]; trailing 1s are
+        # padding (reference treats "3:224:224" == "3:224:224:1")
+        assert parse_dimension("3:224:224:1") == (224, 224, 3)
+        assert parse_dimension("3:224:224:2") == (2, 224, 224, 3)
+
+    def test_parse_strips_trailing_ones(self):
+        assert parse_dimension("10:1:1:1") == (10,)
+
+    def test_parse_zero_terminates(self):
+        assert parse_dimension("3:224:0:5") == (224, 3)
+
+    def test_roundtrip(self):
+        for s in ["3:224:224", "10", "1:2:3:4", "100:100"]:
+            assert serialize_dimension(parse_dimension(s)) == s
+
+    def test_serialize_with_rank_padding(self):
+        assert serialize_dimension((1, 224, 224, 3), rank=6) == "3:224:224:1:1:1"
+
+    def test_rank_limit(self):
+        with pytest.raises(ValueError):
+            parse_dimension(":".join(["2"] * 17))
+
+    def test_scalar(self):
+        assert serialize_dimension(()) == "1"
+
+
+class TestTensorInfo:
+    def test_make_and_size(self):
+        ti = TensorInfo.make("uint8", "3:224:224:1")
+        assert ti.type == TensorType.UINT8
+        assert ti.shape == (224, 224, 3)
+        assert ti.size_bytes == 224 * 224 * 3
+
+    def test_equality_ignores_name(self):
+        a = TensorInfo.make("float32", "10:1", name="a")
+        b = TensorInfo.make("float32", "10:1", name="b")
+        assert a.is_equal(b)
+        assert not a.is_equal(TensorInfo.make("float32", "11:1"))
+
+    def test_tensors_info_strings(self):
+        tsi = TensorsInfo.make("uint8,float32", "3:224:224,1001")
+        assert len(tsi) == 2
+        assert tsi.types_string() == "uint8,float32"
+        assert tsi.dims_string() == "3:224:224,1001"
+        assert tsi.total_size_bytes() == 224 * 224 * 3 + 1001 * 4
+
+    def test_bfloat16(self):
+        ti = TensorInfo.make("bfloat16", "128:128")
+        assert ti.type.element_size == 2
+        assert ti.size_bytes == 128 * 128 * 2
+
+
+class TestConfig:
+    def test_valid_and_equal(self):
+        c1 = TensorsConfig(TensorsInfo.make("uint8", "3:4:4"), rate_n=30, rate_d=1)
+        c2 = TensorsConfig(TensorsInfo.make("uint8", "3:4:4"), rate_n=60, rate_d=2)
+        assert c1.is_valid() and c1.is_equal(c2)
+        assert c1.frame_duration_ns() == 33333333
+
+    def test_flexible_valid_without_info(self):
+        c = TensorsConfig(format=TensorFormat.FLEXIBLE, rate_n=0, rate_d=1)
+        assert c.is_valid()
+
+
+class TestCaps:
+    def test_config_caps_roundtrip(self):
+        cfg = TensorsConfig(TensorsInfo.make("uint8,float32", "3:224:224:1,10:1"),
+                            rate_n=30, rate_d=1)
+        caps = Caps.from_config(cfg)
+        assert caps.is_fixed()
+        cfg2 = Caps(str(caps)).to_config()
+        assert cfg.is_equal(cfg2)
+
+    def test_parse_reference_style(self):
+        caps = Caps('other/tensors,format=(string)static,num_tensors=(int)2,'
+                    'types=(string)"uint8,float32",'
+                    'dimensions=(string)"3:224:224:1,10:1:1:1",'
+                    'framerate=(fraction)30/1')
+        cfg = caps.to_config()
+        assert len(cfg.info) == 2
+        assert cfg.info[0].shape == (224, 224, 3)
+        assert cfg.rate_n == 30
+
+    def test_template_intersection(self):
+        tmpl = Caps.template(("static", "flexible"))
+        fixed = Caps.from_config(
+            TensorsConfig(TensorsInfo.make("uint8", "3:4:4"), rate_n=30, rate_d=1))
+        inter = tmpl.intersect(fixed)
+        assert not inter.is_empty()
+        assert inter.fixate().to_config().info[0].shape == (4, 4, 3)
+
+    def test_no_intersection_on_format_mismatch(self):
+        a = Caps.template(("sparse",))
+        b = Caps.from_config(
+            TensorsConfig(TensorsInfo.make("uint8", "4"), rate_n=0, rate_d=1))
+        assert not a.can_intersect(b)
+
+    def test_any_caps(self):
+        any_caps = Caps.ANY()
+        fixed = Caps.from_config(
+            TensorsConfig(TensorsInfo.make("int8", "2:2"), rate_n=0, rate_d=1))
+        assert any_caps.intersect(fixed) == fixed
+
+    def test_range_intersection(self):
+        a = Caps([__import__("nnstreamer_tpu.tensors.caps", fromlist=["CapsStructure"])
+                  .CapsStructure("other/tensors",
+                                 {"num_tensors": IntRange(1, 16)})])
+        b = Caps([__import__("nnstreamer_tpu.tensors.caps", fromlist=["CapsStructure"])
+                  .CapsStructure("other/tensors", {"num_tensors": 4})])
+        assert a.intersect(b).structures[0].fields["num_tensors"] == 4
+
+    def test_fixate_framerate_range(self):
+        t = Caps.template(("static",))
+        f = t.fixate()
+        assert f.structures[0].fields["framerate"] == Fraction(30, 1)
+
+
+class TestMeta:
+    def test_header_roundtrip(self):
+        m = TensorMetaInfo(TensorType.FLOAT32, TensorFormat.FLEXIBLE,
+                           shape=(1, 8, 8, 3))
+        m2 = TensorMetaInfo.unpack(m.pack())
+        assert m2.type == TensorType.FLOAT32
+        assert m2.shape == (1, 8, 8, 3)
+        assert m2.data_size_bytes == 8 * 8 * 3 * 4
+
+    def test_sparse_nnz(self):
+        m = TensorMetaInfo(TensorType.UINT8, TensorFormat.SPARSE,
+                           shape=(100,), nnz=7)
+        assert TensorMetaInfo.unpack(m.pack()).nnz == 7
+
+    def test_bad_magic(self):
+        with pytest.raises(ValueError):
+            TensorMetaInfo.unpack(b"\x00" * 128)
+
+
+class TestBuffer:
+    def test_host_chunks(self):
+        a = np.arange(12, dtype=np.float32).reshape(3, 4)
+        buf = Buffer.from_arrays([a], pts=1000)
+        assert buf[0].shape == (3, 4)
+        assert not buf[0].is_device
+        assert buf.nbytes == 48
+        info = buf.to_infos()
+        assert info[0].type == TensorType.FLOAT32
+
+    def test_device_roundtrip(self):
+        import jax
+        a = np.ones((2, 2), dtype=np.float32)
+        buf = Buffer.from_arrays([jax.device_put(a)])
+        assert buf[0].is_device
+        np.testing.assert_array_equal(buf[0].host(), a)
+
+    def test_with_chunks_preserves_meta(self):
+        buf = Buffer.from_arrays([np.zeros(3)], pts=5, duration=2)
+        buf.extras["k"] = 1
+        b2 = buf.with_chunks([Chunk(np.ones(4))])
+        assert b2.pts == 5 and b2.duration == 2 and b2.extras["k"] == 1
+
+    def test_many_chunks_no_16_limit(self):
+        buf = Buffer.from_arrays([np.zeros(1, dtype=np.uint8)] * 32)
+        assert len(buf) == 32
