@@ -12,8 +12,14 @@ __version__ = "0.1.0"
 
 from .tensors import (Buffer, Caps, Chunk, TensorFormat, TensorInfo,
                       TensorsConfig, TensorsInfo, TensorType)
+from .pipeline import Pipeline, parse_launch, make_element, register_element
+from . import elements  # noqa: F401  (registers tensor_* elements)
+from . import filters  # noqa: F401  (registers filter backends)
+from .filters import register_custom_easy
+from .single import SingleShot
 
 __all__ = [
     "Buffer", "Chunk", "Caps", "TensorInfo", "TensorsInfo", "TensorsConfig",
-    "TensorType", "TensorFormat", "__version__",
+    "TensorType", "TensorFormat", "Pipeline", "parse_launch", "make_element",
+    "register_element", "register_custom_easy", "SingleShot", "__version__",
 ]

@@ -1,0 +1,4 @@
+"""Tensor pipeline elements (L3)."""
+from . import filter  # noqa: F401  (registers tensor_filter)
+
+__all__: list = []

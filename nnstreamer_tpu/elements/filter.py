@@ -1,0 +1,234 @@
+"""tensor_filter — the inference element.
+
+≙ gst/nnstreamer/tensor_filter/tensor_filter.c (+ tensor_filter_common.c):
+property parsing, framework auto-detection, model-vs-caps verification,
+invoke dispatch, rolling latency/throughput statistics, input/output
+combination, async generative output, suspend watchdog, shared-model key.
+
+TPU-native specifics: chunks handed to the backend may already be
+device-resident (HBM); outputs stay device-resident until a host boundary.
+The hot path is one cached-executable dispatch (SURVEY.md §3.2 analog).
+"""
+from __future__ import annotations
+
+import collections
+import time
+from typing import Any, List, Optional
+
+from ..filters.base import Accelerator, FilterEvent, FilterProperties
+from ..filters.registry import (detect_framework, find_filter,
+                                shared_model_get, shared_model_insert,
+                                shared_model_release)
+from ..tensors.buffer import Buffer, Chunk
+from ..tensors.caps import Caps
+from ..tensors.info import TensorsConfig, TensorsInfo
+from ..tensors.types import TensorFormat
+from ..pipeline.element import Element
+from ..pipeline.pad import Pad
+from ..pipeline.registry import register_element
+from ..utils.log import logger
+from ..utils.watchdog import Watchdog
+
+# rolling window for the latency property
+# (≙ GST_TF_STAT_MAX_RECENT, tensor_filter.c)
+_MAX_RECENT = 10
+
+
+@register_element("tensor_filter")
+class TensorFilter(Element):
+    SINK_TEMPLATES = {"sink": "other/tensors"}
+    SRC_TEMPLATES = {"src": "other/tensors"}
+    PROPS = {
+        "framework": "auto",
+        "model": "",
+        "input": "", "inputtype": "", "inputname": "",
+        "output": "", "outputtype": "", "outputname": "",
+        "accelerator": "",
+        "custom": "",
+        "latency": 0,            # 1 = enable latency property updates
+        "throughput": 0,
+        "invoke-dynamic": False,
+        "invoke-async": False,
+        "suspend": 0,            # idle ms before model unload; 0 = off
+        "shared-tensor-filter-key": "",
+        "input-combination": "",
+        "output-combination": "",
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.fw = None
+        self._fw_owned = True
+        self._in_info: Optional[TensorsInfo] = None
+        self._out_info: Optional[TensorsInfo] = None
+        self._recent_latency = collections.deque(maxlen=_MAX_RECENT)
+        self._invoke_count = 0
+        self._total_latency_ns = 0
+        self._start_time = None
+        self._watchdog: Optional[Watchdog] = None
+        self._in_combi: Optional[List[int]] = None
+        self._out_combi: Optional[List[str]] = None
+
+    # -- framework lifecycle ---------------------------------------------
+    def _open_fw(self) -> None:
+        if self.fw is not None:
+            return
+        models = tuple(m for m in self.model.split(",") if m) if self.model else ()
+        fw_name = self.framework
+        if fw_name in ("auto", ""):
+            fw_name = detect_framework(models)
+        props = FilterProperties(
+            framework=fw_name,
+            model_files=models,
+            accelerators=tuple(Accelerator.parse(self.accelerator)),
+            custom_properties=self.custom,
+            invoke_dynamic=self.invoke_dynamic,
+            invoke_async=self.invoke_async,
+            shared_key=self.shared_tensor_filter_key or None,
+            latency_report=bool(self.latency),
+        )
+        if self.input and self.inputtype:
+            props.input_info = TensorsInfo.make(self.inputtype, self.input)
+        if self.output and self.outputtype:
+            props.output_info = TensorsInfo.make(self.outputtype, self.output)
+
+        fw = None
+        if props.shared_key:
+            # consult the registry BEFORE loading: one HBM copy of the weights
+            fw = shared_model_get(props.shared_key)
+            self._fw_owned = False
+        if fw is None:
+            fw = find_filter(fw_name)()
+            fw.open(props)
+            if props.shared_key:
+                fw = shared_model_insert(props.shared_key, fw)
+        self.fw = fw
+        self._fw_props = props
+        mi_in, mi_out = fw.get_model_info()
+        self._in_info = props.input_info or mi_in
+        self._out_info = props.output_info or mi_out
+        if self.invoke_async:
+            fw.set_async_dispatcher(self._dispatch_async)
+        if self.suspend > 0:
+            self._watchdog = Watchdog(self.suspend / 1000.0, self._on_idle)
+        if self._in_combi is None and self.input_combination:
+            self._in_combi = [int(i) for i in self.input_combination.split(",")]
+        if self._out_combi is None and self.output_combination:
+            self._out_combi = [t.strip() for t in self.output_combination.split(",")]
+
+    def start(self) -> None:
+        super().start()
+        self._open_fw()
+        self._start_time = time.monotonic()
+
+    def stop(self) -> None:
+        super().stop()
+        if self._watchdog is not None:
+            self._watchdog.destroy()
+        if self.fw is not None:
+            key = self.shared_tensor_filter_key
+            if key:
+                shared_model_release(key)
+            elif self._fw_owned:
+                self.fw.close()
+            self.fw = None
+
+    # -- negotiation ------------------------------------------------------
+    def on_sink_caps(self, pad: Pad, caps: Caps) -> None:
+        self._open_fw()
+        cfg = caps.to_config()
+        if self._in_info is not None and cfg.format == TensorFormat.STATIC:
+            sel = cfg.info
+            if self._in_combi:
+                sel = TensorsInfo(cfg.info[i] for i in self._in_combi)
+            if len(sel) and not sel.is_equal(self._in_info):
+                raise ValueError(
+                    f"{self.name}: model input {self._in_info!r} does not match "
+                    f"negotiated stream caps {sel!r}. Check tensor_converter/"
+                    "tensor_transform output dims, or set input/inputtype "
+                    "properties explicitly.")
+        elif self._in_info is None:
+            # push-path: derive model info from caps (SET_INPUT_INFO analog)
+            self._in_info = cfg.info
+            out = self.fw.set_input_info(cfg.info)
+            if out is not None:
+                self._out_info = out
+        if self.invoke_dynamic or self._out_info is None:
+            out_cfg = TensorsConfig(TensorsInfo(), TensorFormat.FLEXIBLE,
+                                    cfg.rate_n, cfg.rate_d)
+        else:
+            out_cfg = TensorsConfig(self._out_info.copy(), TensorFormat.STATIC,
+                                    cfg.rate_n, cfg.rate_d)
+        self.set_src_caps(Caps.from_config(out_cfg))
+
+    # -- hot path ---------------------------------------------------------
+    def do_chain(self, pad: Pad, buf: Buffer) -> None:
+        inputs = [c.raw for c in buf.chunks]
+        if self._in_combi:
+            inputs = [inputs[i] for i in self._in_combi]
+        t0 = time.perf_counter_ns()
+        if self.invoke_async:
+            self._async_template = buf
+            self.fw.invoke_async(inputs)
+            self._record_latency(time.perf_counter_ns() - t0)
+            return
+        outputs = self.fw.invoke(inputs)
+        self._record_latency(time.perf_counter_ns() - t0)
+        if self._watchdog is not None:
+            self._watchdog.feed()
+        out_chunks = self._combine_outputs(buf, outputs)
+        self.push(buf.with_chunks(out_chunks))
+
+    def _combine_outputs(self, inbuf: Buffer, outputs: List[Any]) -> List[Chunk]:
+        if not self._out_combi:
+            return [Chunk(o) for o in outputs]
+        # output-combination: "i0,o1" mixes input passthrough and outputs
+        # (≙ out-combination, tensor_filter.c:972-1076)
+        chunks = []
+        for tok in self._out_combi:
+            kind, idx = tok[0], int(tok[1:])
+            chunks.append(inbuf.chunks[idx] if kind == "i" else Chunk(outputs[idx]))
+        return chunks
+
+    def _dispatch_async(self, outputs: List[Any]) -> None:
+        """Called by the backend once per generated output frame
+        (≙ gst_tensor_filter_async_output_callback, tensor_filter.c:1099)."""
+        template = getattr(self, "_async_template", None)
+        buf = Buffer([Chunk(o) for o in outputs],
+                     pts=template.pts if template else None)
+        self.push(buf)
+
+    # -- stats ------------------------------------------------------------
+    def _record_latency(self, dt_ns: int) -> None:
+        self._invoke_count += 1
+        self._total_latency_ns += dt_ns
+        self._recent_latency.append(dt_ns)
+        if self.latency:
+            self.latency_us = self.latency_average_us()
+
+    def latency_average_us(self) -> float:
+        """Rolling average over the last 10 invokes, µs
+        (≙ latency property, tensor_filter.c:408-448)."""
+        if not self._recent_latency:
+            return 0.0
+        return sum(self._recent_latency) / len(self._recent_latency) / 1e3
+
+    def throughput_fps(self) -> float:
+        """Invokes/sec since start (≙ throughput prop, tensor_filter.c:452)."""
+        if self._start_time is None or self._invoke_count == 0:
+            return 0.0
+        dt = time.monotonic() - self._start_time
+        return self._invoke_count / dt if dt > 0 else 0.0
+
+    # -- suspend ----------------------------------------------------------
+    def _on_idle(self) -> None:
+        if self.fw is not None:
+            logger.info("%s: idle %dms, suspending model", self.name, self.suspend)
+            self.fw.handle_event(FilterEvent.SUSPEND)
+
+    def reload_model(self, model: Optional[str] = None) -> bool:
+        """Hot-swap the model (≙ RELOAD_MODEL / is-updatable path)."""
+        if model:
+            self.model = model
+        data = {"model_files": tuple(self.model.split(","))} if model else None
+        return self.fw.handle_event(FilterEvent.RELOAD_MODEL, data)

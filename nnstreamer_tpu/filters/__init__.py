@@ -1,0 +1,17 @@
+"""Filter-backend subplugins (L5) and their registry (L2)."""
+from . import custom, jax_backend  # noqa: F401  (register built-in backends)
+from .base import (Accelerator, FilterEvent, FilterFramework,
+                   FilterProperties)
+from .custom import register_custom_easy, unregister_custom_easy
+from .registry import (all_filters, detect_framework, find_filter,
+                       register_alias, register_filter, shared_model_get,
+                       shared_model_insert, shared_model_release,
+                       shared_model_replace)
+
+__all__ = [
+    "FilterFramework", "FilterProperties", "FilterEvent", "Accelerator",
+    "register_filter", "register_alias", "find_filter", "all_filters",
+    "detect_framework", "register_custom_easy", "unregister_custom_easy",
+    "shared_model_get", "shared_model_insert", "shared_model_release",
+    "shared_model_replace",
+]

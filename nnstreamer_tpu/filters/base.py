@@ -1,0 +1,131 @@
+"""FilterFramework: the filter-backend subplugin ABI.
+
+The Python analog of GstTensorFilterFramework **v1**
+(ref: gst/nnstreamer/include/nnstreamer_plugin_api_filter.h:399-475 —
+open/close/invoke/getFrameworkInfo/getModelInfo/eventHandler), with the
+reference's event vocabulary (DESTROY_NOTIFY, RELOAD_MODEL, CUSTOM_PROP,
+SET_INPUT_PROP, SET_OUTPUT_PROP, SET_ACCELERATOR, SUSPEND, RESUME) and
+async output dispatch for generative models
+(ref: nnstreamer_filter_dispatch_output_async, :613).
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..tensors.info import TensorsInfo
+
+
+class FilterEvent(enum.Enum):
+    """(ref: event_ops enum, nnstreamer_plugin_api_filter.h:205-217)"""
+
+    DESTROY_NOTIFY = "destroy_notify"
+    RELOAD_MODEL = "reload_model"
+    CUSTOM_PROP = "custom_prop"
+    SET_INPUT_PROP = "set_input_prop"
+    SET_OUTPUT_PROP = "set_output_prop"
+    SET_ACCELERATOR = "set_accelerator"
+    CHECK_HW_AVAILABILITY = "check_hw_availability"
+    SUSPEND = "suspend"
+    RESUME = "resume"
+
+
+class Accelerator(enum.Enum):
+    """(ref: accl_hw enum, nnstreamer_plugin_api_filter.h:80-102).
+    On this framework DEFAULT means the JAX default device (TPU)."""
+
+    NONE = "none"
+    DEFAULT = "default"
+    CPU = "cpu"
+    TPU = "tpu"
+    GPU = "gpu"
+
+    @classmethod
+    def parse(cls, s: str) -> List["Accelerator"]:
+        """Parse reference-style accelerator strings: "true:tpu.cpu"
+        (ref: parse_accl_hw, nnstreamer_plugin_api_filter.h:529-550)."""
+        s = (s or "").strip()
+        if not s or s.lower() in ("false", "none"):
+            return [cls.NONE]
+        if ":" in s:
+            _, rest = s.split(":", 1)
+        elif s.lower() in ("true", "auto"):
+            rest = "default"
+        else:
+            rest = s
+        out = []
+        for part in rest.replace(",", ".").split("."):
+            part = part.strip().lower()
+            if not part:
+                continue
+            try:
+                out.append(cls(part))
+            except ValueError:
+                out.append(cls.DEFAULT)
+        return out or [cls.DEFAULT]
+
+
+@dataclasses.dataclass
+class FilterProperties:
+    """Per-instance filter configuration handed to the framework
+    (ref: GstTensorFilterProperties, nnstreamer_plugin_api_filter.h:112-144)."""
+
+    framework: str = ""
+    model_files: Tuple[str, ...] = ()
+    input_info: Optional[TensorsInfo] = None
+    output_info: Optional[TensorsInfo] = None
+    accelerators: Tuple[Accelerator, ...] = (Accelerator.DEFAULT,)
+    custom_properties: str = ""
+    invoke_dynamic: bool = False   # output shape may vary per invoke
+    invoke_async: bool = False     # N outputs per input via dispatcher
+    shared_key: Optional[str] = None
+    latency_report: bool = False
+
+
+class FilterFramework:
+    """Backend subplugin base class (≙ GstTensorFilterFramework v1).
+
+    Lifecycle: ``open`` loads the model, ``invoke`` runs it, ``close``
+    releases. ``invoke`` takes/returns a list of arrays (host ndarrays or
+    device jax.Arrays — TPU backends keep everything device-resident).
+    """
+
+    NAME = ""
+    # framework auto-detect: model-file extensions this backend claims
+    # (ref: gst_tensor_filter_detect_framework, tensor_filter_common.c:1174)
+    EXTENSIONS: Tuple[str, ...] = ()
+    AVAILABLE = True
+
+    def open(self, props: FilterProperties) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    def invoke(self, inputs: Sequence[Any]) -> List[Any]:
+        raise NotImplementedError
+
+    def get_model_info(self) -> Tuple[Optional[TensorsInfo], Optional[TensorsInfo]]:
+        """(input_info, output_info); either may be None if the backend
+        derives it from the negotiated caps (SET_INPUT_PROP path)."""
+        return None, None
+
+    def set_input_info(self, info: TensorsInfo) -> Optional[TensorsInfo]:
+        """Negotiation push-path: given input info, return output info
+        (≙ getModelInfo SET_INPUT_INFO, nnstreamer_plugin_api_filter.h:439)."""
+        return None
+
+    def handle_event(self, event: FilterEvent, data: Optional[dict] = None) -> bool:
+        """Return True if handled. RELOAD_MODEL/SUSPEND/RESUME arrive here."""
+        return False
+
+    # async generative path -----------------------------------------------
+    def set_async_dispatcher(self, dispatch: Callable[[List[Any]], None]) -> None:
+        """Element installs a callback; an async backend calls it once per
+        produced output frame (≙ nnstreamer_filter_dispatch_output_async)."""
+        self._dispatch = dispatch
+
+    def invoke_async(self, inputs: Sequence[Any]) -> None:
+        """1-in/N-out invoke; outputs flow through the dispatcher."""
+        raise NotImplementedError

@@ -1,0 +1,186 @@
+"""The JAX/XLA TPU filter backend — this framework's native inference engine.
+
+Where the reference fans out to 30 vendor SDK subplugins
+(ref: ext/nnstreamer/tensor_filter/*, SURVEY.md §2.5), the TPU-native
+design collapses them into one backend: a model resolves to a pure
+``apply_fn(params, *inputs)``, params live in HBM, and invoke dispatches a
+**cached jax.jit executable per input signature** (≙ the reference's
+fw->invoke hot call, tensor_filter.c:1227, with the EdgeTPU/TensorRT
+engine-cache idea done the XLA way).
+
+Model URIs accepted by the ``model`` property:
+  * ``zoo://<name>?k=v&...``  — in-repo model zoo (flax), deterministic
+    random init unless ``params_dir=<orbax dir>`` is given.
+  * ``<file>.jaxm.py``        — a python module defining
+    ``get_model() -> (apply_fn, params, input_info, output_info)``.
+  * ``<dir>`` with orbax checkpoint + ``model.json`` zoo spec.
+
+Outputs stay device-resident (jax.Array) so chained elements keep HBM
+residency; they materialize only at host boundaries.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import urllib.parse
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..tensors.info import TensorsInfo
+from ..utils.log import logger
+from .base import Accelerator, FilterEvent, FilterFramework, FilterProperties
+from .registry import register_filter
+
+
+def _device_for(accelerators: Sequence[Accelerator]):
+    import jax
+    for acc in accelerators:
+        if acc in (Accelerator.CPU, Accelerator.NONE):
+            # accelerator=false / cpu is an explicit opt-out of the TPU
+            try:
+                return jax.devices("cpu")[0]
+            except RuntimeError:
+                continue
+        return jax.devices()[0]
+    return jax.devices()[0]
+
+
+@register_filter
+class JaxFilter(FilterFramework):
+    """framework=jax (aliases: jax-tpu). The flagship backend."""
+
+    NAME = "jax"
+    EXTENSIONS = (".py", ".jaxm", ".msgpack")
+
+    def __init__(self):
+        self._apply: Optional[Callable] = None
+        self._params: Any = None
+        self._in_info: Optional[TensorsInfo] = None
+        self._out_info: Optional[TensorsInfo] = None
+        self._jit_cache: Dict[Tuple, Any] = {}
+        self._device = None
+        self._props: Optional[FilterProperties] = None
+        self._lock = threading.Lock()
+        self._suspended = False
+
+    # -- lifecycle --------------------------------------------------------
+    def open(self, props: FilterProperties) -> None:
+        import jax
+        self._props = props
+        self._device = _device_for(props.accelerators)
+        model = props.model_files[0] if props.model_files else ""
+        self._load_model(model, props)
+        if self._params is not None:
+            self._params = jax.device_put(self._params, self._device)
+        logger.info("jax filter opened model=%s on %s", model, self._device)
+
+    def _load_model(self, model: str, props: FilterProperties) -> None:
+        if model.startswith("zoo://"):
+            from ..models import zoo
+            parsed = urllib.parse.urlparse(model)
+            kwargs = {k: v[0] for k, v in
+                      urllib.parse.parse_qs(parsed.query).items()}
+            name = parsed.netloc or parsed.path.lstrip("/")
+            (self._apply, self._params,
+             self._in_info, self._out_info) = zoo.build(name, **kwargs)
+        elif model.endswith(".py"):
+            ns: Dict[str, Any] = {}
+            with open(model) as f:
+                code = f.read()
+            exec(compile(code, model, "exec"), ns)  # noqa: S102 - user script, like python3 subplugin
+            if "get_model" not in ns:
+                raise ValueError(f"{model}: must define get_model()")
+            (self._apply, self._params,
+             self._in_info, self._out_info) = ns["get_model"]()
+        elif os.path.isdir(model) and os.path.exists(
+                os.path.join(model, "model.json")):
+            with open(os.path.join(model, "model.json")) as f:
+                spec = json.load(f)
+            from ..models import zoo
+            (self._apply, self._params,
+             self._in_info, self._out_info) = zoo.build(
+                spec["name"], params_dir=model, **spec.get("kwargs", {}))
+        else:
+            raise ValueError(f"jax backend cannot load model {model!r}")
+
+    def close(self) -> None:
+        self._apply = None
+        self._params = None
+        self._jit_cache.clear()
+
+    # -- info -------------------------------------------------------------
+    def get_model_info(self):
+        return self._in_info, self._out_info
+
+    # -- invoke -----------------------------------------------------------
+    def _executable(self, sig: Tuple) -> Callable:
+        """One compiled executable per input signature (shape/dtype tuple).
+        Recompile-on-new-signature is the static-shape answer to dynamic
+        models (SURVEY.md §7 hard part (a))."""
+        exe = self._jit_cache.get(sig)
+        if exe is None:
+            import jax
+            fn = self._apply
+
+            def call(params, *xs):
+                return fn(params, *xs)
+
+            exe = jax.jit(call)
+            self._jit_cache[sig] = exe
+        return exe
+
+    def invoke(self, inputs: Sequence[Any]) -> List[Any]:
+        import jax
+        with self._lock:
+            if self._suspended:
+                self._resume()
+            xs = [x if isinstance(x, jax.Array) else
+                  jax.device_put(np.asarray(x), self._device) for x in inputs]
+            sig = tuple((tuple(x.shape), str(x.dtype)) for x in xs)
+            out = self._executable(sig)(self._params, *xs)
+        if isinstance(out, (list, tuple)):
+            return list(out)
+        return [out]
+
+    # -- events -----------------------------------------------------------
+    def handle_event(self, event: FilterEvent, data=None) -> bool:
+        if event == FilterEvent.RELOAD_MODEL:
+            # Keep serving with old params while the new ones load
+            # (≙ is-updatable reload, nnstreamer_plugin_api_filter.h:359-365)
+            assert self._props is not None
+            fresh = JaxFilter()
+            fresh.open(self._props if data is None else
+                       self._props.__class__(**{**self._props.__dict__, **data}))
+            with self._lock:
+                self._apply, self._params = fresh._apply, fresh._params
+                self._in_info, self._out_info = fresh._in_info, fresh._out_info
+                self._jit_cache.clear()
+            return True
+        if event == FilterEvent.SUSPEND:
+            # Drop HBM copies; reopen transparently on next invoke
+            # (≙ suspend watchdog unload, tensor_filter.c:1078-1090)
+            import jax
+            with self._lock:
+                self._params = jax.device_get(self._params)
+                self._jit_cache.clear()
+                self._suspended = True
+            return True
+        if event == FilterEvent.RESUME:
+            with self._lock:
+                self._resume()
+            return True
+        return False
+
+    def _resume(self) -> None:
+        import jax
+        if self._suspended:
+            self._params = jax.device_put(self._params, self._device)
+            self._suspended = False
+
+
+from .registry import register_alias as _register_alias  # noqa: E402
+
+_register_alias("jax-tpu", "jax")
+_register_alias("flax", "jax")

@@ -1,0 +1,132 @@
+"""Filter-backend registry: registration, lookup, auto-detection, and the
+shared-model table.
+
+≙ the subplugin registry + framework auto-detection + shared model registry
+(ref: gst/nnstreamer/nnstreamer_subplugin.c:47-137 register/get;
+tensor_filter_common.c:1127-1227 extension-based detection with priority
+lists; nnstreamer_plugin_api_filter.h:560-598 nnstreamer_filter_shared_model_*).
+
+Instead of dlopen'd .so self-registration, backends register via
+``@register_filter`` at import time; out-of-tree backends can use Python
+entry points or plain imports. C custom filters load via ctypes
+(filters/cffi_custom.py).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple, Type
+
+from ..utils.log import logger
+from .base import FilterFramework
+
+_FRAMEWORKS: Dict[str, Type[FilterFramework]] = {}
+_ALIASES: Dict[str, str] = {}
+_LOCK = threading.Lock()
+
+# Detection priority when multiple backends claim an extension
+# (≙ filter-framework-priority in nnstreamer.ini.in:12-19).
+_PRIORITY = ["jax", "flax", "custom-easy", "python3", "tflite-interop",
+             "torch-interop", "onnx-interop"]
+
+
+def register_filter(cls: Type[FilterFramework]) -> Type[FilterFramework]:
+    with _LOCK:
+        _FRAMEWORKS[cls.NAME] = cls
+    return cls
+
+
+def register_alias(alias: str, target: str) -> None:
+    """(≙ [filter-aliases] section of nnstreamer.ini)"""
+    _ALIASES[alias] = target
+
+
+def find_filter(name: str) -> Type[FilterFramework]:
+    name = _ALIASES.get(name, name)
+    with _LOCK:
+        if name not in _FRAMEWORKS:
+            raise ValueError(
+                f"unknown filter framework {name!r}; known: {sorted(_FRAMEWORKS)}")
+        cls = _FRAMEWORKS[name]
+    if not cls.AVAILABLE:
+        raise ValueError(f"filter framework {name!r} is not available "
+                         "(missing optional dependency)")
+    return cls
+
+
+def all_filters() -> List[str]:
+    with _LOCK:
+        return sorted(_FRAMEWORKS)
+
+
+def detect_framework(model_files: Tuple[str, ...]) -> str:
+    """Pick a framework from model file extension(s)
+    (≙ gst_tensor_filter_detect_framework, tensor_filter_common.c:1174-1227)."""
+    if not model_files:
+        raise ValueError("cannot auto-detect framework without model files")
+    ext = os.path.splitext(model_files[0])[1].lower()
+    with _LOCK:
+        candidates = [
+            (name, cls) for name, cls in _FRAMEWORKS.items()
+            if ext in cls.EXTENSIONS and cls.AVAILABLE]
+    if not candidates:
+        raise ValueError(f"no framework claims model extension {ext!r}")
+    candidates.sort(key=lambda kv: _PRIORITY.index(kv[0])
+                    if kv[0] in _PRIORITY else len(_PRIORITY))
+    name = candidates[0][0]
+    logger.info("auto-detected framework %s for %s", name, model_files[0])
+    return name
+
+
+# -- shared model registry -------------------------------------------------
+# (≙ nnstreamer_filter_shared_model_get/insert/remove/replace,
+#  nnstreamer_plugin_api_filter.h:560-598): instances with the same
+#  shared-tensor-filter-key share one opened backend (one HBM copy of the
+#  weights — on TPU this is the difference between N models and 1).
+
+_SHARED: Dict[str, Tuple[FilterFramework, int]] = {}
+_SHARED_LOCK = threading.Lock()
+
+
+def shared_model_get(key: str) -> Optional[FilterFramework]:
+    with _SHARED_LOCK:
+        entry = _SHARED.get(key)
+        if entry is None:
+            return None
+        fw, refs = entry
+        _SHARED[key] = (fw, refs + 1)
+        return fw
+
+
+def shared_model_insert(key: str, fw: FilterFramework) -> FilterFramework:
+    with _SHARED_LOCK:
+        if key in _SHARED:
+            existing, refs = _SHARED[key]
+            _SHARED[key] = (existing, refs + 1)
+            return existing
+        _SHARED[key] = (fw, 1)
+        return fw
+
+
+def shared_model_release(key: str) -> bool:
+    """Drop one ref; close and remove on last release. Returns True if the
+    backend was closed."""
+    with _SHARED_LOCK:
+        if key not in _SHARED:
+            return False
+        fw, refs = _SHARED[key]
+        if refs <= 1:
+            del _SHARED[key]
+            fw.close()
+            return True
+        _SHARED[key] = (fw, refs - 1)
+        return False
+
+
+def shared_model_replace(key: str, fw: FilterFramework) -> None:
+    """Hot-swap the shared backend under the same key (≙ ..._replace)."""
+    with _SHARED_LOCK:
+        old = _SHARED.get(key)
+        _SHARED[key] = (fw, old[1] if old else 1)
+        if old is not None:
+            old[0].close()

@@ -1,0 +1,5 @@
+"""Model zoo (flax/jax model builders for the jax filter backend)."""
+from . import zoo
+from .zoo import build, model_names, register_model
+
+__all__ = ["zoo", "build", "model_names", "register_model"]

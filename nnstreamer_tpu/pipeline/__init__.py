@@ -1,0 +1,16 @@
+"""Dataflow pipeline runtime (L0/L3 skeleton)."""
+from . import basic  # noqa: F401  (registers core elements)
+from .element import Element, SinkElement, SrcElement, TransformElement
+from .events import (CapsEvent, CustomEvent, EosEvent, Event, FlushEvent,
+                     SegmentEvent, StreamStart)
+from .pad import FlowError, Pad, PadDirection
+from .parser import parse_launch
+from .pipeline import Bus, Message, Pipeline
+from .registry import element_names, make_element, register_element
+
+__all__ = [
+    "Element", "SrcElement", "SinkElement", "TransformElement", "Pad",
+    "PadDirection", "FlowError", "Pipeline", "Bus", "Message", "parse_launch",
+    "register_element", "make_element", "element_names", "Event", "CapsEvent",
+    "EosEvent", "StreamStart", "SegmentEvent", "FlushEvent", "CustomEvent",
+]

@@ -1,0 +1,266 @@
+"""Generic plumbing elements: queue, tee, capsfilter, identity, appsrc,
+appsink, fakesink (the GStreamer core-element analogs the reference's
+pipelines lean on, e.g. ``queue`` for thread boundaries and ``tee`` for
+fan-out in composite pipelines, README.md multi-model examples)."""
+from __future__ import annotations
+
+import queue as _pyqueue
+import threading
+from typing import Callable, List, Optional
+
+from ..tensors.buffer import Buffer
+from ..tensors.caps import Caps
+from ..utils.log import logger
+from .element import Element, SinkElement, SrcElement, TransformElement
+from .events import CapsEvent, EosEvent, Event
+from .pad import FlowError, Pad, PadDirection
+from .registry import register_element
+
+_SENTINEL = object()
+
+
+@register_element("queue")
+class Queue(Element):
+    """Thread boundary with a bounded buffer queue.
+
+    Backpressure: upstream ``chain`` blocks when the queue is full
+    (matching gst queue defaults). ``leaky=downstream`` drops the incoming
+    buffer instead — used by QoS-style pipelines.
+    """
+
+    SINK_TEMPLATES = {"sink": None}
+    SRC_TEMPLATES = {"src": None}
+    PROPS = {"max-size-buffers": 16, "leaky": "none"}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._q: _pyqueue.Queue = _pyqueue.Queue(maxsize=max(1, self.max_size_buffers))
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+
+    def start(self) -> None:
+        super().start()
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._worker, name=f"queue:{self.name}", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        super().stop()
+        try:
+            self._q.put_nowait(_SENTINEL)
+        except _pyqueue.Full:
+            try:
+                self._q.get_nowait()
+                self._q.put_nowait(_SENTINEL)
+            except (_pyqueue.Empty, _pyqueue.Full):
+                pass
+        if self._thread is not None and self._thread is not threading.current_thread():
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def chain(self, pad: Pad, item) -> None:
+        if isinstance(item, Event):
+            self._q.put(item)  # events are serialized: never dropped
+            return
+        if self.leaky == "downstream" :
+            try:
+                self._q.put_nowait(item)
+            except _pyqueue.Full:
+                pass  # drop newest
+        else:
+            self._q.put(item)  # blocking: backpressure
+
+    def _worker(self) -> None:
+        while self._running:
+            item = self._q.get()
+            if item is _SENTINEL:
+                break
+            try:
+                if isinstance(item, Event):
+                    if isinstance(item, CapsEvent):
+                        self.sinkpad.set_caps(item.caps)
+                        self.set_src_caps(item.caps)
+                    else:
+                        self.forward_event(item)
+                else:
+                    self.stats["buffers"] += 1
+                    self.stats["bytes"] += item.nbytes
+                    self.srcpad.push(item)
+            except FlowError:
+                break
+            except Exception as exc:  # noqa: BLE001
+                logger.exception("%s: error in queue worker", self.name)
+                self.post_error(exc)
+                break
+
+
+@register_element("tee")
+class Tee(Element):
+    """1-to-N fan-out. Buffers are shared, not copied: chunks are
+    immutable by convention (device arrays are immutable anyway)."""
+
+    SINK_TEMPLATES = {"sink": None}
+    SRC_TEMPLATES = {"src_%u": None}
+
+    def do_chain(self, pad: Pad, buf: Buffer) -> None:
+        for p in self.src_pads.values():
+            if p.is_linked:
+                p.push(buf)
+
+    def on_sink_caps(self, pad: Pad, caps: Caps) -> None:
+        self.set_src_caps(caps)
+
+
+@register_element("capsfilter")
+class CapsFilter(TransformElement):
+    """Pass-through that restricts negotiation to its ``caps`` property."""
+
+    PROPS = {"caps": ""}
+
+    def transform(self, buf: Buffer) -> Buffer:
+        return buf
+
+    def transform_caps(self, incaps: Caps) -> Optional[Caps]:
+        if not self.caps:
+            return incaps
+        want = Caps(self.caps) if isinstance(self.caps, str) else self.caps
+        out = incaps.intersect(want)
+        if out.is_empty():
+            raise ValueError(
+                f"{self.name}: caps {incaps} do not satisfy filter {want}")
+        return out.fixate() if not out.is_fixed() else out
+
+
+@register_element("identity")
+class Identity(TransformElement):
+    PROPS = {"silent": True}
+
+    def transform(self, buf: Buffer) -> Buffer:
+        if not self.silent:
+            logger.info("%s: buffer pts=%s chunks=%d", self.name, buf.pts, len(buf))
+        return buf
+
+
+@register_element("appsrc")
+class AppSrc(SrcElement):
+    """Application-driven source: the app thread calls ``push_buffer`` /
+    ``end_stream``; the src loop relays into the pipeline."""
+
+    PROPS = {"caps": "", "max-buffers": 64}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._q: _pyqueue.Queue = _pyqueue.Queue(maxsize=max(1, self.max_buffers))
+
+    def push_buffer(self, buf: Buffer) -> None:
+        self._q.put(buf)
+
+    def end_stream(self) -> None:
+        self._q.put(_SENTINEL)
+
+    def negotiate_src_caps(self) -> Optional[Caps]:
+        return Caps(self.caps) if self.caps else None
+
+    def create(self) -> Optional[Buffer]:
+        while not self._stop_evt.is_set():
+            try:
+                item = self._q.get(timeout=0.1)
+            except _pyqueue.Empty:
+                continue
+            return None if item is _SENTINEL else item
+        return None
+
+
+@register_element("appsink")
+class AppSink(SinkElement):
+    """Collecting sink with an optional new-data callback
+    (≙ tensor_sink's ``new-data`` signal, ref: gsttensor_sink.c)."""
+
+    PROPS = {"max-buffers": 0, "emit-signals": True}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.buffers: List[Buffer] = []
+        self.callback: Optional[Callable[[Buffer], None]] = None
+        self._lock = threading.Lock()
+
+    def connect(self, callback: Callable[[Buffer], None]) -> None:
+        self.callback = callback
+
+    def render(self, buf: Buffer) -> None:
+        with self._lock:
+            self.buffers.append(buf)
+            if self.max_buffers > 0 and len(self.buffers) > self.max_buffers:
+                self.buffers.pop(0)
+        if self.callback is not None:
+            self.callback(buf)
+
+    def pop_all(self) -> List[Buffer]:
+        with self._lock:
+            out, self.buffers = self.buffers, []
+            return out
+
+
+@register_element("tensortestsrc")
+class TensorTestSrc(SrcElement):
+    """Synthetic tensor source (≙ videotestsrc feeding tensor_converter in
+    reference test pipelines). Generates frames matching its ``caps``
+    property with a chosen fill pattern; PTS synthesized from framerate."""
+
+    PROPS = {"caps": "", "pattern": "counter", "seed": 0, "is-live": False}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._config = None
+        self._count = 0
+        self._rng = None
+
+    def negotiate_src_caps(self) -> Optional[Caps]:
+        if not self.caps:
+            raise ValueError(f"{self.name}: 'caps' property is required")
+        caps = Caps(self.caps)
+        if not caps.is_fixed():
+            caps = caps.fixate()
+        self._config = caps.to_config()
+        return caps
+
+    def create(self) -> Optional[Buffer]:
+        import numpy as np
+        if self._rng is None:
+            self._rng = np.random.default_rng(self.seed)
+        cfg = self._config
+        chunks = []
+        for info in cfg.info:
+            dt = info.type.np_dtype
+            if self.pattern == "zeros":
+                arr = np.zeros(info.shape, dtype=dt)
+            elif self.pattern == "ones":
+                arr = np.ones(info.shape, dtype=dt)
+            elif self.pattern == "random":
+                if np.issubdtype(np.dtype(dt), np.integer):
+                    ii = np.iinfo(dt)
+                    arr = self._rng.integers(ii.min, ii.max, info.shape,
+                                             dtype=dt, endpoint=True)
+                else:
+                    arr = self._rng.random(info.shape).astype(dt)
+            else:  # counter
+                arr = np.full(info.shape, self._count).astype(dt)
+            chunks.append(Buffer.from_arrays([arr])[0])
+        dur = cfg.frame_duration_ns()
+        pts = self._count * dur if dur else self._count
+        self._count += 1
+        if self.is_live and dur:
+            import time as _t
+            _t.sleep(dur / 1e9)
+        return Buffer(chunks, pts=pts, duration=dur)
+
+
+@register_element("fakesink")
+class FakeSink(SinkElement):
+    PROPS = {"dump": False}
+
+    def render(self, buf: Buffer) -> None:
+        if self.dump:
+            logger.info("%s: pts=%s %r", self.name, buf.pts, buf)

@@ -1,0 +1,38 @@
+"""Element factory registry (≙ the GST_PLUGIN_DEFINE element registerer,
+ref: gst/nnstreamer/registerer/nnstreamer.c:91-121).
+
+Elements register by name with the ``@register_element`` decorator; the
+launch-string parser instantiates through :func:`make_element`.
+"""
+from __future__ import annotations
+
+from typing import Dict, Type
+
+_ELEMENTS: Dict[str, type] = {}
+
+
+def register_element(name: str):
+    def deco(cls: type) -> type:
+        if name in _ELEMENTS and _ELEMENTS[name] is not cls:
+            raise ValueError(f"element name {name!r} already registered")
+        _ELEMENTS[name] = cls
+        cls.ELEMENT_NAME = name
+        return cls
+    return deco
+
+
+def make_element(kind: str, name=None, **props):
+    try:
+        cls = _ELEMENTS[kind]
+    except KeyError:
+        raise ValueError(
+            f"no such element {kind!r}; known: {sorted(_ELEMENTS)}") from None
+    return cls(name=name, **props)
+
+
+def element_names():
+    return sorted(_ELEMENTS)
+
+
+def get_element_class(kind: str) -> type:
+    return _ELEMENTS[kind]

@@ -5,11 +5,13 @@ dimension-string grammar of the reference
 (ref: gst/nnstreamer/nnstreamer_plugin_api_util_impl.c — parse/compare/copy
 dimension helpers; tensor_typedef.h:273-289 struct layout).
 
-Dimension strings are reference-compatible: ``"3:224:224:1"`` is
-innermost-first (channel:width:height:batch for NHWC video). Internally we
-keep NumPy/JAX order (outermost-first), i.e. that string parses to shape
-``(1, 224, 224, 3)``. Trailing :1 padding is accepted and stripped on parse;
-``to_dim_string()`` emits the minimal form.
+Dimension strings are reference-compatible: ``"3:224:224"`` is
+innermost-first (channel:width:height for NHWC video). Internally we keep
+NumPy/JAX order (outermost-first), i.e. that string parses to shape
+``(224, 224, 3)``. Trailing ``:1`` padding is accepted and **stripped** on
+parse (the reference pads ranks with 1s, so ``"3:224:224:1"`` equals
+``"3:224:224"`` and also parses to ``(224, 224, 3)``);
+``dim_string()`` emits the minimal form.
 """
 from __future__ import annotations
 
@@ -23,10 +25,11 @@ from .types import RANK_LIMIT, TENSOR_COUNT_LIMIT, TensorFormat, TensorType
 def parse_dimension(dim_str: str) -> Tuple[int, ...]:
     """Parse a reference-style dimension string into a NumPy-order shape.
 
-    ``"3:224:224:1"`` -> ``(1, 224, 224, 3)``. A trailing run of 1s beyond
-    the last meaningful dim is stripped (the reference pads ranks with 1s,
-    nnstreamer_plugin_api_util_impl.c dimension parsing). ``0`` terminates
-    the dimension (unspecified remainder), matching the reference.
+    ``"3:224:224:2"`` -> ``(2, 224, 224, 3)``; trailing 1s are rank padding
+    and are stripped, so ``"3:224:224:1"`` -> ``(224, 224, 3)`` (the
+    reference pads ranks with 1s, nnstreamer_plugin_api_util_impl.c
+    dimension parsing). ``0`` terminates the dimension (unspecified
+    remainder), matching the reference.
     """
     dim_str = dim_str.strip()
     if not dim_str:

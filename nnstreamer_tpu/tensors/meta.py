@@ -60,7 +60,10 @@ class TensorMetaInfo:
         return math.prod(self.shape or (0,)) * self.type.element_size
 
     def pack(self) -> bytes:
-        dims = list(reversed(self.shape))[:RANK_LIMIT]
+        if len(self.shape) > RANK_LIMIT:
+            raise ValueError(
+                f"rank {len(self.shape)} exceeds limit {RANK_LIMIT}")
+        dims = list(reversed(self.shape))
         rank = len(dims)
         dims += [1] * (RANK_LIMIT - len(dims))
         body = _FIXED.pack(

@@ -111,12 +111,23 @@ def _bf16_np_dtype():
         raise RuntimeError("bfloat16 requires jax or ml_dtypes")
 
 
-_NP_DTYPES = {
+class _LazyDtypes(dict):
+    """bfloat16 resolves lazily so a jax-less host falls back to ml_dtypes
+    (or raises) instead of silently yielding None."""
+
+    def __missing__(self, key):
+        if key is TensorType.BFLOAT16:
+            dt = np.dtype(_bf16_np_dtype())
+            self[key] = dt
+            return dt
+        raise KeyError(key)
+
+
+_NP_DTYPES = _LazyDtypes({
     t: np.dtype(_TYPE_NAMES[t])
     for t in TensorType
     if t is not TensorType.BFLOAT16
-}
-_NP_DTYPES[TensorType.BFLOAT16] = np.dtype(_bf16_np_dtype()) if _HAS_JAX else None
+})
 
 _ELEMENT_SIZES = {
     TensorType.INT32: 4,

@@ -1,0 +1,4 @@
+"""Training subplugins and checkpointing (L3 trainer backend)."""
+from .checkpoint import restore_params, save_params
+
+__all__ = ["restore_params", "save_params"]

@@ -1,0 +1,33 @@
+"""Param checkpoint save/restore via orbax.
+
+≙ the reference's model-save-path / model-load-path trainer properties
+(ref: include/nnstreamer_plugin_api_trainer.h:35-36 — save at training end,
+resume by loading). Orbax is the TPU-native answer: sharding-aware,
+async-capable checkpoints.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any
+
+
+def save_params(path: str, params: Any) -> None:
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(path)
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(os.path.join(path, "params"), params, force=True)
+
+
+def restore_params(path: str, like: Any = None) -> Any:
+    """Restore params saved by :func:`save_params`. ``like`` provides the
+    target structure/shardings (restores as-saved when None)."""
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(path)
+    ckptr = ocp.PyTreeCheckpointer()
+    target = os.path.join(path, "params")
+    if like is not None:
+        import jax
+        restored = ckptr.restore(target, item=like)
+    else:
+        restored = ckptr.restore(target)
+    return restored

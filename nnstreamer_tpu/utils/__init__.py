@@ -1,0 +1,4 @@
+"""Utilities: logging, config, watchdog."""
+from .log import logger
+
+__all__ = ["logger"]

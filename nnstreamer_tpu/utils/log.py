@@ -1,0 +1,17 @@
+"""Framework logging (≙ ml_loge/logw/logi/logd macros,
+ref: gst/nnstreamer/nnstreamer_log.c:35-64 -- error logs there attach a
+backtrace; Python's logging.exception gives us the same for free)."""
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger("nnstreamer_tpu")
+
+_level = os.environ.get("NNS_TPU_LOG", "WARNING").upper()
+if not logger.handlers:
+    handler = logging.StreamHandler()
+    handler.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname).1s nns-tpu %(message)s"))
+    logger.addHandler(handler)
+    logger.setLevel(getattr(logging, _level, logging.WARNING))

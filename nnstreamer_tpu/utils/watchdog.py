@@ -1,0 +1,32 @@
+"""Resettable watchdog timer.
+
+≙ nnstreamer_watchdog.c (GMainLoop-in-thread timer used for tensor_filter
+``suspend`` model unloading, armed per-invoke at tensor_filter.c:1259-1266).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+
+class Watchdog:
+    def __init__(self, timeout_s: float, callback: Callable[[], None]):
+        self.timeout_s = timeout_s
+        self.callback = callback
+        self._timer: Optional[threading.Timer] = None
+        self._lock = threading.Lock()
+
+    def feed(self) -> None:
+        """(Re)arm: postpone firing by another timeout."""
+        with self._lock:
+            if self._timer is not None:
+                self._timer.cancel()
+            self._timer = threading.Timer(self.timeout_s, self.callback)
+            self._timer.daemon = True
+            self._timer.start()
+
+    def destroy(self) -> None:
+        with self._lock:
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None

@@ -1,0 +1,194 @@
+"""tensor_filter element + backend tests (scope ≙ reference
+tests/nnstreamer_filter_custom, _shared_model, _reload, unittest_filter_*;
+custom-easy fixtures stand in for real models per SURVEY.md §4)."""
+import time
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nt
+from nnstreamer_tpu.filters import (FilterEvent, all_filters, detect_framework,
+                                    register_custom_easy)
+from nnstreamer_tpu.tensors import TensorsInfo
+
+
+@pytest.fixture(autouse=True)
+def _fixtures():
+    # ≙ custom_example_passthrough / _scaler fixtures
+    register_custom_easy(
+        "passthrough", lambda *xs: list(xs),
+        TensorsInfo.make("float32", "8"), TensorsInfo.make("float32", "8"))
+    register_custom_easy(
+        "scaler2x", lambda x: x * 2,
+        TensorsInfo.make("float32", "8"), TensorsInfo.make("float32", "8"))
+    yield
+
+
+CAPS_F32 = ("other/tensors,format=static,num_tensors=1,types=float32,"
+            "dimensions=8,framerate=0/1")
+
+
+class TestCustomEasy:
+    def test_passthrough_pipeline(self):
+        p = nt.parse_launch(
+            f"tensortestsrc caps={CAPS_F32} num-buffers=3 pattern=ones ! "
+            "tensor_filter framework=custom-easy model=passthrough ! "
+            "appsink name=out")
+        p.run(10)
+        assert len(p["out"].buffers) == 3
+        np.testing.assert_allclose(p["out"].buffers[0][0].host(), 1.0)
+
+    def test_scaler(self):
+        p = nt.parse_launch(
+            f"tensortestsrc caps={CAPS_F32} num-buffers=2 pattern=ones ! "
+            "tensor_filter framework=custom-easy model=scaler2x ! "
+            "appsink name=out")
+        p.run(10)
+        np.testing.assert_allclose(p["out"].buffers[0][0].host(), 2.0)
+
+    def test_model_caps_mismatch_errors(self):
+        bad = CAPS_F32.replace("dimensions=8", "dimensions=9")
+        p = nt.parse_launch(
+            f"tensortestsrc caps={bad} num-buffers=1 ! "
+            "tensor_filter framework=custom-easy model=passthrough ! fakesink")
+        p.start()
+        with pytest.raises(ValueError, match="does not match"):
+            p.wait_eos(5)
+        p.stop()
+
+    def test_unknown_model(self):
+        p = nt.parse_launch(
+            f"tensortestsrc caps={CAPS_F32} num-buffers=1 ! "
+            "tensor_filter framework=custom-easy model=nope ! fakesink")
+        with pytest.raises(ValueError, match="not registered"):
+            p.start()
+        p.stop()
+
+    def test_output_caps_negotiated(self):
+        p = nt.parse_launch(
+            f"tensortestsrc caps={CAPS_F32} num-buffers=1 ! "
+            "tensor_filter framework=custom-easy model=passthrough ! "
+            "appsink name=out")
+        p.run(10)
+        caps = p["out"].sinkpad.caps
+        assert caps.to_config().info[0].shape == (8,)
+
+
+class TestJaxBackend:
+    def test_zoo_mlp_pipeline(self):
+        caps = CAPS_F32.replace("dimensions=8", "dimensions=64")
+        p = nt.parse_launch(
+            f"tensortestsrc caps={caps} num-buffers=3 pattern=random ! "
+            "tensor_filter framework=jax model=zoo://mlp ! appsink name=out")
+        p.run(30)
+        bufs = p["out"].buffers
+        assert len(bufs) == 3
+        assert bufs[0][0].shape == (10,)
+        assert bufs[0][0].is_device  # output stays HBM/device-resident
+
+    def test_jit_cache_reused(self):
+        from nnstreamer_tpu.filters.jax_backend import JaxFilter
+        from nnstreamer_tpu.filters.base import FilterProperties
+        f = JaxFilter()
+        f.open(FilterProperties(framework="jax", model_files=("zoo://mlp",)))
+        x = np.random.rand(64).astype(np.float32)
+        f.invoke([x])
+        assert len(f._jit_cache) == 1
+        f.invoke([x * 2])
+        assert len(f._jit_cache) == 1  # same signature: cached
+        f.invoke([np.random.rand(2, 64).astype(np.float32)])
+        assert len(f._jit_cache) == 2  # new signature: recompiled
+        f.close()
+
+    def test_suspend_resume_preserves_outputs(self):
+        from nnstreamer_tpu.filters.jax_backend import JaxFilter
+        from nnstreamer_tpu.filters.base import FilterProperties
+        f = JaxFilter()
+        f.open(FilterProperties(framework="jax", model_files=("zoo://mlp",)))
+        x = np.random.rand(64).astype(np.float32)
+        y0 = np.asarray(f.invoke([x])[0])
+        assert f.handle_event(FilterEvent.SUSPEND)
+        assert f._suspended
+        y1 = np.asarray(f.invoke([x])[0])  # transparent resume
+        np.testing.assert_allclose(y0, y1)
+        f.close()
+
+    def test_reload_model(self):
+        from nnstreamer_tpu.filters.jax_backend import JaxFilter
+        from nnstreamer_tpu.filters.base import FilterProperties
+        f = JaxFilter()
+        f.open(FilterProperties(framework="jax", model_files=("zoo://mlp",)))
+        assert f.handle_event(FilterEvent.RELOAD_MODEL)
+        x = np.random.rand(64).astype(np.float32)
+        assert np.asarray(f.invoke([x])[0]).shape == (10,)
+        f.close()
+
+
+class TestSingleShot:
+    def test_invoke(self):
+        with nt.SingleShot("zoo://mlp?out_dim=5", framework="jax") as s:
+            out = s.invoke([np.random.rand(64).astype(np.float32)])
+        assert np.asarray(out[0]).shape == (5,)
+
+    def test_model_info(self):
+        with nt.SingleShot("passthrough", framework="custom-easy") as s:
+            i, o = s.get_model_info()
+        assert i[0].shape == (8,)
+
+    def test_custom_easy_single(self):
+        with nt.SingleShot("scaler2x", framework="custom-easy") as s:
+            out = s.invoke([np.full(8, 3.0, np.float32)])
+        np.testing.assert_allclose(out[0], 6.0)
+
+
+class TestSharedModel:
+    def test_shared_key_single_backend(self):
+        p = nt.parse_launch(
+            f"tensortestsrc caps={CAPS_F32} num-buffers=2 pattern=ones ! "
+            "tee name=t "
+            "t. ! queue ! tensor_filter name=f1 framework=custom-easy "
+            "model=passthrough shared-tensor-filter-key=k1 ! appsink name=a "
+            "t. ! queue ! tensor_filter name=f2 framework=custom-easy "
+            "model=passthrough shared-tensor-filter-key=k1 ! appsink name=b")
+        p.run(10)
+        assert p["f1"].fw is None and p["f2"].fw is None  # released on stop
+        assert len(p["a"].buffers) == 2 and len(p["b"].buffers) == 2
+
+    def test_shared_instances_are_same_object(self):
+        from nnstreamer_tpu.pipeline import make_element
+        f1 = make_element("tensor_filter", framework="custom-easy",
+                          model="passthrough", **{"shared-tensor-filter-key": "kk"})
+        f2 = make_element("tensor_filter", framework="custom-easy",
+                          model="passthrough", **{"shared-tensor-filter-key": "kk"})
+        f1.start(); f2.start()
+        assert f1.fw is f2.fw
+        f1.stop(); f2.stop()
+
+
+class TestStats:
+    def test_latency_and_throughput(self):
+        register_custom_easy("slow10ms",
+                             lambda x: (time.sleep(0.01), x)[1],
+                             TensorsInfo.make("float32", "8"),
+                             TensorsInfo.make("float32", "8"))
+        p = nt.parse_launch(
+            f"tensortestsrc caps={CAPS_F32} num-buffers=5 ! "
+            "tensor_filter name=f framework=custom-easy model=slow10ms latency=1 ! "
+            "fakesink")
+        p.run(10)
+        f = p["f"]
+        assert f.latency_average_us() >= 10_000  # >= injected 10ms delay
+        assert 0 < f.throughput_fps() < 100
+
+
+class TestDetect:
+    def test_detect_by_extension(self):
+        assert detect_framework(("model.py",)) in ("jax", "python3")
+
+    def test_detect_no_claim(self):
+        with pytest.raises(ValueError, match="no framework claims"):
+            detect_framework(("model.unknownext",))
+
+    def test_known_backends(self):
+        names = all_filters()
+        assert {"jax", "custom-easy", "python3"} <= set(names)

@@ -1,0 +1,188 @@
+"""Pipeline runtime tests: parser, dataflow, caps negotiation, threading,
+backpressure, branching (scope ≙ reference unittest_sink/unittest_plugins
+pipeline-construction tests, which build pipelines from launch strings)."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.pipeline import (FlowError, Pipeline, TransformElement,
+                                     element_names, make_element, parse_launch,
+                                     register_element)
+from nnstreamer_tpu.tensors import Buffer, Caps
+
+CAPS_U8 = ("other/tensors,format=static,num_tensors=1,types=uint8,"
+           "dimensions=4:4,framerate=0/1")
+
+
+def launch_and_run(desc, timeout=10.0):
+    p = parse_launch(desc)
+    p.run(timeout)
+    return p
+
+
+class TestParser:
+    def test_simple_chain(self):
+        p = parse_launch(f"tensortestsrc caps={CAPS_U8} num-buffers=3 ! "
+                         "identity ! appsink name=out")
+        assert set(p.elements) >= {"out"}
+        assert len(p.elements) == 3
+
+    def test_named_branching(self):
+        p = parse_launch(
+            f"tensortestsrc caps={CAPS_U8} num-buffers=2 ! tee name=t "
+            "t. ! queue ! appsink name=a "
+            "t. ! queue ! appsink name=b")
+        assert "a" in p.elements and "b" in p.elements
+
+    def test_quoted_property(self):
+        p = parse_launch(
+            'tensortestsrc caps="other/tensors,format=static,num_tensors=1,'
+            'types=float32,dimensions=10,framerate=30/1" num-buffers=1 '
+            "! appsink name=out")
+        p.run(5)
+        assert p["out"].buffers[0][0].dtype == np.float32
+
+    def test_unknown_element(self):
+        with pytest.raises(ValueError, match="no such element"):
+            parse_launch("nonexistent_element ! fakesink")
+
+    def test_dangling_link(self):
+        with pytest.raises(ValueError, match="dangling"):
+            parse_launch("identity !")
+
+    def test_prop_before_element(self):
+        with pytest.raises(ValueError):
+            parse_launch("foo=bar identity")
+
+    def test_unknown_property(self):
+        with pytest.raises(ValueError, match="no property"):
+            parse_launch("identity bogus=1")
+
+
+class TestDataflow:
+    def test_end_to_end_counts(self):
+        p = launch_and_run(
+            f"tensortestsrc caps={CAPS_U8} num-buffers=5 ! identity ! "
+            "appsink name=out")
+        bufs = p["out"].buffers
+        assert len(bufs) == 5
+        assert bufs[0][0].shape == (4, 4)
+
+    def test_pattern_counter_and_pts(self):
+        caps = CAPS_U8.replace("framerate=0/1", "framerate=10/1")
+        p = launch_and_run(
+            f"tensortestsrc caps={caps} num-buffers=3 pattern=counter ! "
+            "appsink name=out")
+        bufs = p["out"].buffers
+        assert [int(b[0].host()[0, 0]) for b in bufs] == [0, 1, 2]
+        assert [b.pts for b in bufs] == [0, 100_000_000, 200_000_000]
+        assert bufs[0].duration == 100_000_000
+
+    def test_tee_fanout(self):
+        p = launch_and_run(
+            f"tensortestsrc caps={CAPS_U8} num-buffers=4 ! tee name=t "
+            "t. ! queue ! appsink name=a "
+            "t. ! queue ! appsink name=b")
+        assert len(p["a"].buffers) == 4
+        assert len(p["b"].buffers) == 4
+
+    def test_queue_thread_boundary(self):
+        seen_threads = set()
+
+        @register_element("threadprobe")
+        class ThreadProbe(TransformElement):  # noqa
+            def transform(self, buf):
+                seen_threads.add(threading.current_thread().name)
+                return buf
+
+        p = launch_and_run(
+            f"tensortestsrc caps={CAPS_U8} num-buffers=2 ! queue name=q ! "
+            "threadprobe ! appsink name=out")
+        assert len(p["out"].buffers) == 2
+        assert any(t.startswith("queue:q") for t in seen_threads)
+
+    def test_backpressure_blocks_not_drops(self):
+        p = parse_launch(
+            f"tensortestsrc caps={CAPS_U8} num-buffers=50 ! "
+            "queue max-size-buffers=2 ! appsink name=out")
+        slow = threading.Event()
+
+        def slow_cb(buf):
+            time.sleep(0.002)
+
+        p["out"].connect(slow_cb)
+        p.run(20)
+        assert len(p["out"].buffers) == 50  # nothing dropped
+
+    def test_leaky_queue_drops(self):
+        p = parse_launch(
+            f"tensortestsrc caps={CAPS_U8} num-buffers=200 ! "
+            "queue max-size-buffers=2 leaky=downstream ! appsink name=out")
+        p["out"].connect(lambda b: time.sleep(0.001))
+        p.run(20)
+        assert 0 < len(p["out"].buffers) < 200
+
+    def test_appsrc_push(self):
+        p = parse_launch(f"appsrc name=src caps={CAPS_U8} ! appsink name=out")
+        p.start()
+        for i in range(3):
+            p["src"].push_buffer(
+                Buffer.from_arrays([np.full((4, 4), i, np.uint8)], pts=i))
+        p["src"].end_stream()
+        assert p.wait_eos(5)
+        p.stop()
+        assert len(p["out"].buffers) == 3
+
+    def test_error_propagates_to_bus(self):
+        @register_element("explodeelem")
+        class Explode(TransformElement):  # noqa
+            def transform(self, buf):
+                raise RuntimeError("boom")
+
+        p = parse_launch(f"tensortestsrc caps={CAPS_U8} num-buffers=1 ! "
+                         "explodeelem ! fakesink")
+        p.start()
+        with pytest.raises(RuntimeError, match="boom"):
+            p.wait_eos(5)
+        p.stop()
+
+    def test_element_stats_proctime(self):
+        p = launch_and_run(
+            f"tensortestsrc caps={CAPS_U8} num-buffers=3 ! identity name=i ! "
+            "appsink name=out")
+        st = p.stats()["i"]
+        assert st["buffers"] == 3
+        assert st["bytes"] == 3 * 16
+
+
+class TestCapsNegotiation:
+    def test_capsfilter_pass(self):
+        p = launch_and_run(
+            f"tensortestsrc caps={CAPS_U8} num-buffers=1 ! "
+            "other/tensors,format=static ! appsink name=out")
+        assert len(p["out"].buffers) == 1
+
+    def test_capsfilter_reject(self):
+        p = parse_launch(
+            f"tensortestsrc caps={CAPS_U8} num-buffers=1 ! "
+            "other/tensors,format=sparse ! appsink name=out")
+        p.start()
+        with pytest.raises(ValueError, match="do not satisfy"):
+            p.wait_eos(5)
+        p.stop()
+
+    def test_sink_pad_sees_fixed_caps(self):
+        p = launch_and_run(f"tensortestsrc caps={CAPS_U8} num-buffers=1 ! "
+                           "appsink name=out")
+        caps = p["out"].sinkpad.caps
+        assert caps is not None and caps.is_fixed()
+        assert caps.to_config().info[0].shape == (4, 4)
+
+
+def test_core_elements_registered():
+    names = element_names()
+    for n in ["queue", "tee", "capsfilter", "identity", "appsrc", "appsink",
+              "fakesink", "tensortestsrc"]:
+        assert n in names
