@@ -1,0 +1,73 @@
+#!/usr/bin/env python3
+"""Headline benchmark: MobileNet-v2 image-labeling pipeline throughput.
+
+Mirrors the reference's golden pipeline (MobileNet classification via
+gst-launch, ref: tests/nnstreamer_filter_tensorflow2_lite/runTest.sh:69-80)
+as a native pipeline on the JAX/XLA backend. Baseline target from
+BASELINE.json north star: >= 30 fps/chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+
+BASELINE_FPS = 30.0
+WARMUP = 12
+FRAMES = 300
+
+
+def main() -> int:
+    from nnstreamer_tpu.pipeline.parser import parse_launch
+
+    desc = (
+        "tensortestsrc caps=\"other/tensors,format=static,num_tensors=1,"
+        "types=(string)uint8,dimensions=(string)3:224:224,"
+        f"framerate=(fraction)0/1\" pattern=random num-buffers={WARMUP + FRAMES} "
+        "! queue max-size-buffers=4 "
+        "! tensor_filter framework=jax model=zoo://mobilenet_v2 latency=1 "
+        "name=f ! appsink name=out emit-signals=true"
+    )
+    pipe = parse_launch(desc)
+    mark = {"t0": None, "t1": None, "n": 0}
+    done = threading.Event()
+
+    def on_buffer(buf):
+        mark["n"] += 1
+        if mark["n"] == WARMUP:  # jit compile + cache warm by now
+            mark["t0"] = time.perf_counter()
+        elif mark["n"] == WARMUP + FRAMES:
+            # drain the async dispatch queue: the clock stops only when the
+            # last frame's logits are actually materialized on device
+            import jax
+            jax.block_until_ready(buf.arrays())
+            mark["t1"] = time.perf_counter()
+            done.set()
+
+    pipe["out"].connect(on_buffer)
+    pipe.start()
+    ok = done.wait(timeout=600)
+    pipe.stop()
+    if not ok or mark["t0"] is None or mark["t1"] is None:
+        print(f"ERROR: saw {mark['n']} frames, "
+              f"expected {WARMUP + FRAMES}", file=sys.stderr)
+        return 1
+    fps = FRAMES / (mark["t1"] - mark["t0"])
+    print(json.dumps({
+        "metric": "mobilenet_v2_pipeline_fps",
+        "value": round(fps, 2),
+        "unit": "fps",
+        "vs_baseline": round(fps / BASELINE_FPS, 3),
+    }))
+    filt = pipe["f"]
+    print(f"# frames={FRAMES} wall={mark['t1'] - mark['t0']:.2f}s "
+          f"invoke_recent_avg_us={filt.latency_average_us():.0f}",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,10 @@
+"""Converter subplugins: arbitrary media -> tensors.
+
+≙ ext/nnstreamer/tensor_converter/* (flatbuf/flexbuf/protobuf/python3) and
+the external-converter hook in gsttensor_converter.c (_NNS_MEDIA_ANY).
+"""
+from . import registry
+from .registry import ConverterPlugin, find_converter, register_converter
+
+__all__ = ["registry", "ConverterPlugin", "find_converter",
+           "register_converter"]

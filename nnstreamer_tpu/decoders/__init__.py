@@ -1,0 +1,12 @@
+"""Decoder subplugins: tensors -> media.
+
+≙ ext/nnstreamer/tensor_decoder/* (direct_video, image_labeling,
+bounding_boxes with pluggable box-properties classes, pose_estimation,
+image_segment, tensor_region, ...).
+"""
+from . import registry
+from .registry import DecoderPlugin, find_decoder, register_decoder
+from . import (bounding_box, direct_video, image_label,  # noqa: F401
+               pose, segment, tensor_region)
+
+__all__ = ["registry", "DecoderPlugin", "find_decoder", "register_decoder"]

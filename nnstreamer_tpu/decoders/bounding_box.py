@@ -1,0 +1,196 @@
+"""bounding_boxes decoder: detection tensors -> RGBA overlay video.
+
+≙ ext/nnstreamer/tensor_decoder/tensordec-boundingbox.cc with its
+pluggable BoxProperties classes (tensordec-boundingbox.h:236-305):
+yolov5/yolov8 (box_properties/yolo.cc), mobilenet-ssd (mobilenetssd.cc),
+mobilenet-ssd-postprocess (mobilenetssdpp.cc).
+
+Options (reference-compatible):
+  option1 = mode: yolov5 | yolov8 | mobilenet-ssd-postprocess | custom
+  option2 = labels file
+  option3 = mode-specific (yolo: "scale:conf:iou"; ssd-pp: tensor order)
+  option4 = output video size "W:H"
+  option5 = model input size "W:H"
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import numpy as np
+
+from ..tensors.buffer import Buffer, Chunk
+from ..tensors.caps import Caps
+from ..tensors.info import TensorsConfig
+from .image_label import load_labels
+from .registry import DecoderPlugin, register_decoder
+
+_PALETTE = np.array([
+    [255, 64, 64, 255], [64, 255, 64, 255], [64, 64, 255, 255],
+    [255, 255, 64, 255], [255, 64, 255, 255], [64, 255, 255, 255],
+    [255, 160, 64, 255], [160, 64, 255, 255]], np.uint8)
+
+
+@dataclasses.dataclass
+class DetectedBox:
+    x: float       # normalized [0,1] left
+    y: float       # top
+    w: float
+    h: float
+    cls: int
+    score: float
+
+
+def iou(a: DetectedBox, b: DetectedBox) -> float:
+    x1, y1 = max(a.x, b.x), max(a.y, b.y)
+    x2 = min(a.x + a.w, b.x + b.w)
+    y2 = min(a.y + a.h, b.y + b.h)
+    inter = max(0.0, x2 - x1) * max(0.0, y2 - y1)
+    union = a.w * a.h + b.w * b.h - inter
+    return inter / union if union > 0 else 0.0
+
+
+def nms(boxes: List[DetectedBox], threshold: float = 0.5) -> List[DetectedBox]:
+    """Greedy per-class non-max suppression (≙ reference nms in
+    tensordec-boundingbox.cc)."""
+    out: List[DetectedBox] = []
+    for b in sorted(boxes, key=lambda b: -b.score):
+        if all(o.cls != b.cls or iou(o, b) < threshold for o in out):
+            out.append(b)
+    return out
+
+
+def draw_boxes(boxes: List[DetectedBox], width: int, height: int,
+               thickness: int = 2) -> np.ndarray:
+    """Rasterize box outlines onto a transparent RGBA canvas."""
+    canvas = np.zeros((height, width, 4), np.uint8)
+    for b in boxes:
+        color = _PALETTE[b.cls % len(_PALETTE)]
+        x0 = int(np.clip(b.x * width, 0, width - 1))
+        y0 = int(np.clip(b.y * height, 0, height - 1))
+        x1 = int(np.clip((b.x + b.w) * width, 0, width - 1))
+        y1 = int(np.clip((b.y + b.h) * height, 0, height - 1))
+        t = thickness
+        canvas[y0:y0 + t, x0:x1 + 1] = color
+        canvas[max(0, y1 - t + 1):y1 + 1, x0:x1 + 1] = color
+        canvas[y0:y1 + 1, x0:x0 + t] = color
+        canvas[y0:y1 + 1, max(0, x1 - t + 1):x1 + 1] = color
+    return canvas
+
+
+@register_decoder
+class BoundingBoxes(DecoderPlugin):
+    NAME = "bounding_boxes"
+
+    def set_options(self, options) -> None:
+        super().set_options(options)
+        self.mode = self.option(1) or "yolov5"
+        self._labels = load_labels(self.option(2)) if self.option(2) else None
+        self.out_w, self.out_h = self._parse_wh(self.option(4), (640, 480))
+        self.in_w, self.in_h = self._parse_wh(self.option(5),
+                                              (self.out_w, self.out_h))
+        opt3 = self.option(3)
+        self.conf_threshold, self.iou_threshold, self.scaled = 0.25, 0.45, False
+        if self.mode in ("yolov5", "yolov8") and opt3:
+            parts = opt3.split(":")
+            if parts and parts[0]:
+                self.scaled = parts[0] not in ("0", "false")
+            if len(parts) > 1 and parts[1]:
+                self.conf_threshold = float(parts[1])
+            if len(parts) > 2 and parts[2]:
+                self.iou_threshold = float(parts[2])
+
+    @staticmethod
+    def _parse_wh(opt: str, default):
+        if not opt:
+            return default
+        w, h = opt.split(":")
+        return int(w), int(h)
+
+    def get_out_caps(self, config: TensorsConfig) -> Caps:
+        rate = f"{config.rate_n}/{config.rate_d}"
+        return Caps(f"video/x-raw,format=RGBA,width={self.out_w},"
+                    f"height={self.out_h},framerate=(fraction){rate}")
+
+    # -- per-mode tensor parsing (the BoxProperties analog) ---------------
+    def _boxes_yolov5(self, buf: Buffer) -> List[DetectedBox]:
+        """pred [N, 5+nc]: cx,cy,w,h,obj,cls... (pixel scale when
+        option3 scaled=1, else normalized)."""
+        pred = buf.chunks[0].host()
+        pred = pred.reshape(-1, pred.shape[-1])
+        scale_w = self.in_w if self.scaled else 1.0
+        scale_h = self.in_h if self.scaled else 1.0
+        obj = pred[:, 4]
+        cls_scores = pred[:, 5:] * obj[:, None]
+        cls = np.argmax(cls_scores, axis=1)
+        score = cls_scores[np.arange(len(cls)), cls]
+        keep = score >= self.conf_threshold
+        out = []
+        for p, c, s in zip(pred[keep], cls[keep], score[keep]):
+            cx, cy, w, h = (p[0] / scale_w, p[1] / scale_h,
+                            p[2] / scale_w, p[3] / scale_h)
+            out.append(DetectedBox(cx - w / 2, cy - h / 2, w, h,
+                                   int(c), float(s)))
+        return nms(out, self.iou_threshold)
+
+    def _boxes_yolov8(self, buf: Buffer) -> List[DetectedBox]:
+        """pred [4+nc, N] (or [N, 4+nc]): cx,cy,w,h,cls... (no objectness)."""
+        pred = buf.chunks[0].host()
+        pred = pred.reshape(pred.shape[-2], pred.shape[-1]) \
+            if pred.ndim > 2 else pred
+        if pred.shape[0] < pred.shape[1]:
+            pred = pred.T  # -> [N, 4+nc]
+        scale_w = self.in_w if self.scaled else 1.0
+        scale_h = self.in_h if self.scaled else 1.0
+        cls_scores = pred[:, 4:]
+        cls = np.argmax(cls_scores, axis=1)
+        score = cls_scores[np.arange(len(cls)), cls]
+        keep = score >= self.conf_threshold
+        out = []
+        for p, c, s in zip(pred[keep], cls[keep], score[keep]):
+            cx, cy, w, h = (p[0] / scale_w, p[1] / scale_h,
+                            p[2] / scale_w, p[3] / scale_h)
+            out.append(DetectedBox(cx - w / 2, cy - h / 2, w, h,
+                                   int(c), float(s)))
+        return nms(out, self.iou_threshold)
+
+    def _boxes_ssd_pp(self, buf: Buffer) -> List[DetectedBox]:
+        """TFLite detection-postprocess convention: boxes [N,4]
+        (ymin,xmin,ymax,xmax normalized), classes [N], scores [N],
+        count [1] (≙ mobilenetssdpp.cc tensor order, option3 reorders)."""
+        order = [int(i) for i in self.option(3).split(":")] \
+            if self.option(3) else [0, 1, 2, 3]
+        chunks = [buf.chunks[i].host() for i in order]
+        boxes, classes, scores, count = chunks
+        n = int(count.reshape(-1)[0])
+        boxes = boxes.reshape(-1, 4)
+        out = []
+        for i in range(min(n, len(boxes))):
+            s = float(scores.reshape(-1)[i])
+            if s < self.conf_threshold:
+                continue
+            ymin, xmin, ymax, xmax = boxes[i]
+            out.append(DetectedBox(float(xmin), float(ymin),
+                                   float(xmax - xmin), float(ymax - ymin),
+                                   int(classes.reshape(-1)[i]), s))
+        return out
+
+    def decode(self, buf: Buffer) -> Optional[Buffer]:
+        if self.mode == "yolov5":
+            boxes = self._boxes_yolov5(buf)
+        elif self.mode == "yolov8":
+            boxes = self._boxes_yolov8(buf)
+        elif self.mode in ("mobilenet-ssd-postprocess", "mobilenetssd-pp",
+                           "tflite-ssd-postprocess"):
+            boxes = self._boxes_ssd_pp(buf)
+        else:
+            raise ValueError(f"bounding_boxes: unknown mode {self.mode!r}")
+        frame = draw_boxes(boxes, self.out_w, self.out_h)
+        out = Buffer([Chunk(frame)])
+        out.extras["boxes"] = [
+            {"x": b.x, "y": b.y, "w": b.w, "h": b.h, "class": b.cls,
+             "label": (self._labels[b.cls] if self._labels and
+                       b.cls < len(self._labels) else str(b.cls)),
+             "score": b.score}
+            for b in boxes]
+        return out

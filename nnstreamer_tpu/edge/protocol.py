@@ -1,0 +1,93 @@
+"""Length-prefixed tensor message framing over TCP.
+
+≙ nnstreamer-edge's nns_edge_data_* wire format (serialize per-frame
+tensor payloads + metadata, SURVEY.md §5 distributed backend). A message
+is::
+
+    magic   u32  0x4E4E5445 ("NNTE")
+    kind    u8   MsgKind
+    meta    u32 len + utf-8 JSON (caps/client_id/pts/shapes/dtypes)
+    n       u32  payload count
+    n x (u64 len + bytes)
+
+Tensor payloads ride as raw bytes; dtypes/shapes live in the JSON meta so
+flexible streams need no renegotiation.
+"""
+from __future__ import annotations
+
+import enum
+import json
+import socket
+import struct
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+MAGIC = 0x4E4E5445
+_HDR = struct.Struct("<IBI")
+_PLEN = struct.Struct("<Q")
+
+
+class MsgKind(enum.IntEnum):
+    CAPS = 1        # caps string exchange at connect
+    CAPS_ACK = 2
+    DATA = 3        # client -> server frame
+    RESULT = 4      # server -> client frame
+    EOS = 5
+    ERROR = 6
+    SUBSCRIBE = 7   # edgesrc -> edgesink hello
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        part = sock.recv(n - len(buf))
+        if not part:
+            raise ConnectionError("peer closed")
+        buf.extend(part)
+    return bytes(buf)
+
+
+def send_msg(sock: socket.socket, kind: MsgKind, meta: Dict,
+             payloads: Sequence[bytes] = ()) -> None:
+    mb = json.dumps(meta).encode()
+    parts = [_HDR.pack(MAGIC, int(kind), len(mb)), mb,
+             struct.pack("<I", len(payloads))]
+    for p in payloads:
+        parts.append(_PLEN.pack(len(p)))
+        parts.append(p)
+    sock.sendall(b"".join(parts))
+
+
+def recv_msg(sock: socket.socket) -> Tuple[MsgKind, Dict, List[bytes]]:
+    magic, kind, mlen = _HDR.unpack(_read_exact(sock, _HDR.size))
+    if magic != MAGIC:
+        raise ValueError(f"bad magic {magic:#x}")
+    meta = json.loads(_read_exact(sock, mlen)) if mlen else {}
+    (n,) = struct.unpack("<I", _read_exact(sock, 4))
+    payloads = []
+    for _ in range(n):
+        (plen,) = _PLEN.unpack(_read_exact(sock, _PLEN.size))
+        payloads.append(_read_exact(sock, plen))
+    return MsgKind(kind), meta, payloads
+
+
+def buffer_to_wire(buf) -> Tuple[Dict, List[bytes]]:
+    """Buffer -> (meta, payloads); dtype/shape per chunk in meta."""
+    tensors = []
+    payloads = []
+    for c in buf.chunks:
+        arr = c.host()
+        tensors.append({"dtype": str(arr.dtype), "shape": list(arr.shape)})
+        payloads.append(arr.tobytes())
+    meta = {"pts": buf.pts, "duration": buf.duration, "tensors": tensors}
+    return meta, payloads
+
+
+def wire_to_buffer(meta: Dict, payloads: List[bytes]):
+    from ..tensors.buffer import Buffer, Chunk
+    chunks = []
+    for t, p in zip(meta.get("tensors", []), payloads):
+        arr = np.frombuffer(p, np.dtype(t["dtype"])).reshape(t["shape"])
+        chunks.append(Chunk(arr))
+    return Buffer(chunks, pts=meta.get("pts"), duration=meta.get("duration"))

@@ -1,4 +1,21 @@
-"""Tensor pipeline elements (L3)."""
-from . import filter  # noqa: F401  (registers tensor_filter)
+"""Tensor pipeline elements (L3) — importing this package registers every
+element with the factory (≙ registerer/nnstreamer.c GST_PLUGIN_DEFINE)."""
+from . import filter  # noqa: F401  (tensor_filter)
+from . import media  # noqa: F401  (videotestsrc/audiotestsrc/file IO)
+from . import converter  # noqa: F401  (tensor_converter)
+from . import transform  # noqa: F401  (tensor_transform)
+from . import decoder  # noqa: F401  (tensor_decoder)
+from . import combiner  # noqa: F401  (tensor_mux/tensor_merge/join)
+from . import splitter  # noqa: F401  (tensor_demux/tensor_split)
+from . import aggregator  # noqa: F401  (tensor_aggregator)
+from . import flowctl  # noqa: F401  (tensor_if/tensor_rate)
+from . import crop  # noqa: F401  (tensor_crop)
+from . import repo  # noqa: F401  (tensor_reposink/tensor_reposrc)
+from . import sparse  # noqa: F401  (tensor_sparse_enc/dec)
+from . import sinks  # noqa: F401  (tensor_sink/tensor_debug)
+from . import trainer  # noqa: F401  (tensor_trainer)
+from . import datarepo  # noqa: F401  (datareposrc/datareposink)
+from . import query  # noqa: F401  (tensor_query_client/serversrc/serversink)
+from . import edge  # noqa: F401  (edgesrc/edgesink)
 
 __all__: list = []

@@ -1,0 +1,184 @@
+"""edgesink / edgesrc — tensor stream pub/sub between pipelines/hosts.
+
+≙ gst/edge/edge_sink.c + edge_src.c (thin publisher/subscriber over
+nnstreamer-edge): edgesink accepts N subscribers and broadcasts every
+buffer; edgesrc connects and replays the feed into its pipeline.
+Topic filtering mirrors the MQTT-hybrid topic semantics: a subscriber
+passes ``topic`` at SUBSCRIBE and only receives matching streams.
+"""
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..edge.protocol import (MsgKind, buffer_to_wire, recv_msg, send_msg,
+                             wire_to_buffer)
+from ..pipeline.element import SinkElement, SrcElement
+from ..pipeline.pad import Pad
+from ..pipeline.registry import register_element
+from ..tensors.buffer import Buffer
+from ..tensors.caps import Caps
+from ..utils.log import logger
+
+
+@register_element("edgesink")
+class EdgeSink(SinkElement):
+    PROPS = {"host": "localhost", "port": 3000, "topic": "",
+             "connect-type": "TCP"}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._listener: Optional[socket.socket] = None
+        self._subs: List[socket.socket] = []
+        self._subs_lock = threading.Lock()
+        self._stop_evt = threading.Event()
+        self._caps_str = ""
+
+    @property
+    def bound_port(self) -> int:
+        return self._listener.getsockname()[1] if self._listener else self.port
+
+    def start(self) -> None:
+        super().start()
+        self._stop_evt.clear()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((self.host, self.port))
+        self._listener.listen(16)
+        threading.Thread(target=self._accept_loop,
+                         name=f"edgesink-accept:{self.name}",
+                         daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+            self._listener = None
+        with self._subs_lock:
+            for s in self._subs:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._subs.clear()
+        super().stop()
+
+    def on_sink_caps(self, pad: Pad, caps: Caps) -> None:
+        self._caps_str = str(caps)
+
+    def handle_event(self, pad, event) -> None:
+        from ..pipeline.events import CapsEvent
+        if isinstance(event, CapsEvent):
+            pad.set_caps(event.caps)
+            self.on_sink_caps(pad, event.caps)
+            return
+        super().handle_event(pad, event)
+
+    def _accept_loop(self) -> None:
+        while not self._stop_evt.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            try:
+                kind, meta, _ = recv_msg(conn)
+                want = meta.get("topic", "")
+                if kind != MsgKind.SUBSCRIBE or \
+                        (self.topic and want and want != self.topic):
+                    send_msg(conn, MsgKind.ERROR, {"reason": "topic mismatch"})
+                    conn.close()
+                    continue
+                send_msg(conn, MsgKind.CAPS_ACK,
+                         {"caps": self._caps_str, "topic": self.topic})
+            except (ConnectionError, OSError):
+                continue
+            with self._subs_lock:
+                self._subs.append(conn)
+
+    def render(self, buf: Buffer) -> None:
+        meta, payloads = buffer_to_wire(buf)
+        if self.topic:
+            meta["topic"] = self.topic
+        dead = []
+        with self._subs_lock:
+            subs = list(self._subs)
+        for s in subs:
+            try:
+                send_msg(s, MsgKind.DATA, meta, payloads)
+            except (ConnectionError, OSError):
+                dead.append(s)
+        if dead:
+            with self._subs_lock:
+                for s in dead:
+                    if s in self._subs:
+                        self._subs.remove(s)
+
+    def on_eos(self) -> None:
+        with self._subs_lock:
+            subs = list(self._subs)
+        for s in subs:
+            try:
+                send_msg(s, MsgKind.EOS, {})
+            except (ConnectionError, OSError):
+                pass
+        super().on_eos()
+
+
+@register_element("edgesrc")
+class EdgeSrc(SrcElement):
+    PROPS = {"dest-host": "localhost", "dest-port": 3000, "topic": "",
+             "connect-type": "TCP", "timeout": 10.0}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._sock: Optional[socket.socket] = None
+
+    def negotiate_src_caps(self) -> Optional[Caps]:
+        deadline = time.monotonic() + self.timeout
+        last_err = None
+        while time.monotonic() < deadline:
+            try:
+                self._sock = socket.create_connection(
+                    (self.dest_host, int(self.dest_port)),
+                    timeout=self.timeout)
+                break
+            except OSError as e:
+                last_err = e
+                time.sleep(0.05)
+        else:
+            raise ConnectionError(
+                f"{self.name}: cannot reach edgesink at "
+                f"{self.dest_host}:{self.dest_port}: {last_err}")
+        send_msg(self._sock, MsgKind.SUBSCRIBE, {"topic": self.topic})
+        kind, meta, _ = recv_msg(self._sock)
+        if kind != MsgKind.CAPS_ACK:
+            raise ConnectionError(f"{self.name}: subscribe rejected ({kind})")
+        caps_str = meta.get("caps") or "other/tensors,format=flexible"
+        return Caps(caps_str)
+
+    def create(self) -> Optional[Buffer]:
+        try:
+            while not self._stop_evt.is_set():
+                kind, meta, payloads = recv_msg(self._sock)
+                if kind == MsgKind.DATA:
+                    return wire_to_buffer(meta, payloads)
+                if kind == MsgKind.EOS:
+                    return None
+        except (ConnectionError, OSError):
+            if not self._stop_evt.is_set():
+                logger.info("%s: publisher closed", self.name)
+        return None
+
+    def stop(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        super().stop()

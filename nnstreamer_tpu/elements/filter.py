@@ -80,7 +80,10 @@ class TensorFilter(Element):
         props = FilterProperties(
             framework=fw_name,
             model_files=models,
-            accelerators=tuple(Accelerator.parse(self.accelerator)),
+            # empty accelerator property = framework default (TPU), like the
+            # reference's auto mode; an explicit "false"/"cpu" opts out
+            accelerators=(tuple(Accelerator.parse(self.accelerator))
+                          if self.accelerator else (Accelerator.DEFAULT,)),
             custom_properties=self.custom,
             invoke_dynamic=self.invoke_dynamic,
             invoke_async=self.invoke_async,

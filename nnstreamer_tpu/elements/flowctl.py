@@ -1,0 +1,203 @@
+"""tensor_if / tensor_rate — data-dependent flow control & QoS.
+
+≙ gst/nnstreamer/elements/gsttensor_if.c (condition on tensor values,
+then/else actions, custom C callback via include/tensor_if.h) and
+gsttensor_rate.c (framerate control + throttling).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..pipeline.element import Element, TransformElement
+from ..pipeline.events import EosEvent
+from ..pipeline.pad import Pad, PadDirection
+from ..pipeline.registry import register_element
+from ..tensors.buffer import Buffer
+from ..tensors.caps import Caps
+from ..tensors.info import TensorsConfig, TensorsInfo
+
+# runtime-registered custom conditions (≙ nnstreamer_if_custom_register)
+_custom_conditions: Dict[str, Callable[[Buffer], bool]] = {}
+_cc_lock = threading.Lock()
+
+
+def register_if_condition(name: str, fn: Callable[[Buffer], bool]) -> None:
+    with _cc_lock:
+        _custom_conditions[name] = fn
+
+
+def unregister_if_condition(name: str) -> None:
+    with _cc_lock:
+        _custom_conditions.pop(name, None)
+
+
+_OPERATORS = {
+    "EQ": lambda v, sv: v == sv[0],
+    "NE": lambda v, sv: v != sv[0],
+    "GT": lambda v, sv: v > sv[0],
+    "GE": lambda v, sv: v >= sv[0],
+    "LT": lambda v, sv: v < sv[0],
+    "LE": lambda v, sv: v <= sv[0],
+    "RANGE_INCLUSIVE": lambda v, sv: sv[0] <= v <= sv[1],
+    "RANGE_EXCLUSIVE": lambda v, sv: sv[0] < v < sv[1],
+    "NOT_IN_RANGE_INCLUSIVE": lambda v, sv: not (sv[0] <= v <= sv[1]),
+    "NOT_IN_RANGE_EXCLUSIVE": lambda v, sv: not (sv[0] < v < sv[1]),
+}
+
+
+@register_element("tensor_if")
+class TensorIf(Element):
+    """Condition-gated routing: ``then`` branch on src_0, ``else`` branch
+    on src_1 (each action PASSTHROUGH | SKIP | TENSORPICK)."""
+
+    SINK_TEMPLATES = {"sink": "other/tensors"}
+    SRC_TEMPLATES = {"src_%u": "other/tensors"}
+    PROPS = {
+        "compared-value": "A_VALUE",        # A_VALUE | TENSOR_AVERAGE_VALUE | CUSTOM
+        "compared-value-option": "",        # "d0:d1:d2:d3,n" | "n" | custom name
+        "operator": "EQ",
+        "supplied-value": "",               # "v" or "v1:v2" for ranges
+        "then": "PASSTHROUGH",
+        "then-option": "",
+        "else": "SKIP",
+        "else-option": "",
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._then_pad: Optional[Pad] = None
+        self._else_pad: Optional[Pad] = None
+
+    def _pads(self):
+        if self._then_pad is None:
+            self._then_pad = self.get_static_or_request_pad(
+                "src_0", PadDirection.SRC)
+            self._else_pad = self.get_static_or_request_pad(
+                "src_1", PadDirection.SRC)
+        return self._then_pad, self._else_pad
+
+    # -- negotiation ------------------------------------------------------
+    def on_sink_caps(self, pad: Pad, caps: Caps) -> None:
+        cfg = caps.to_config()
+        then_pad, else_pad = self._pads()
+        for p, action, option in ((then_pad, self.get_property("then"),
+                                   self.then_option),
+                                  (else_pad, self.get_property("else"),
+                                   self.else_option)):
+            if not p.is_linked or action == "SKIP":
+                continue
+            out = cfg
+            if action == "TENSORPICK" and option:
+                picks = [int(i) for i in option.split(",")]
+                out = TensorsConfig(
+                    TensorsInfo(cfg.info[i].copy() for i in picks),
+                    cfg.format, cfg.rate_n, cfg.rate_d)
+            self.set_src_caps(Caps.from_config(out), pad=p)
+
+    # -- condition --------------------------------------------------------
+    def _compared_value(self, buf: Buffer) -> float:
+        cv = self.compared_value
+        opt = self.compared_value_option
+        if cv == "A_VALUE":
+            # "d0:d1:...,n" — innermost-first element index + tensor id
+            idx_str, _, tid_str = opt.partition(",")
+            tid = int(tid_str or 0)
+            arr = buf.chunks[tid].host()
+            ref_idx = [int(i) for i in idx_str.split(":")] if idx_str else []
+            ref_idx += [0] * (arr.ndim - len(ref_idx))
+            np_idx = tuple(reversed(ref_idx[:arr.ndim]))
+            return float(arr[np_idx])
+        if cv == "TENSOR_AVERAGE_VALUE":
+            tid = int(opt or 0)
+            return float(np.mean(buf.chunks[tid].host()))
+        raise ValueError(f"{self.name}: unknown compared-value {cv!r}")
+
+    def _evaluate(self, buf: Buffer) -> bool:
+        if self.compared_value == "CUSTOM":
+            with _cc_lock:
+                fn = _custom_conditions.get(self.compared_value_option)
+            if fn is None:
+                raise ValueError(
+                    f"{self.name}: no custom condition "
+                    f"{self.compared_value_option!r} registered")
+            return bool(fn(buf))
+        v = self._compared_value(buf)
+        sv = [float(x) for x in self.supplied_value.split(":") if x != ""]
+        op = _OPERATORS.get(self.operator.upper())
+        if op is None:
+            raise ValueError(f"{self.name}: unknown operator {self.operator!r}")
+        return op(v, sv)
+
+    # -- dataflow ---------------------------------------------------------
+    def do_chain(self, pad: Pad, buf: Buffer) -> None:
+        result = self._evaluate(buf)
+        then_pad, else_pad = self._pads()
+        action = self.get_property("then") if result else self.get_property("else")
+        option = self.then_option if result else self.else_option
+        out_pad = then_pad if result else else_pad
+        if action == "SKIP" or not out_pad.is_linked:
+            return
+        if action == "TENSORPICK" and option:
+            picks = [int(i) for i in option.split(",")]
+            buf = buf.with_chunks([buf.chunks[i] for i in picks])
+        out_pad.push(buf)
+
+
+@register_element("tensor_rate")
+class TensorRate(TransformElement):
+    """PTS-based framerate conversion: drop early frames, duplicate the
+    previous frame to fill gaps; throttling QoS counters exposed as
+    properties (≙ gsttensor_rate.c in/out/dup/drop)."""
+
+    PROPS = {"framerate": "", "throttle": True, "silent": True}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._next_ts: Optional[int] = None
+        self._prev: Optional[Buffer] = None
+        self.stats.update({"in": 0, "out": 0, "dup": 0, "drop": 0})
+
+    def _target(self):
+        if not self.framerate:
+            return None
+        n, _, d = self.framerate.partition("/")
+        return int(n), int(d or 1)
+
+    def transform_caps(self, incaps: Caps) -> Optional[Caps]:
+        tgt = self._target()
+        if tgt is None:
+            return incaps
+        cfg = incaps.to_config()
+        cfg = TensorsConfig(cfg.info, cfg.format, tgt[0], tgt[1])
+        return Caps.from_config(cfg)
+
+    def transform(self, buf: Buffer) -> Optional[Buffer]:
+        tgt = self._target()
+        self.stats["in"] += 1
+        if tgt is None or buf.pts is None:
+            self.stats["out"] += 1
+            return buf
+        period = int(1e9 * tgt[1] / tgt[0])
+        if self._next_ts is None:
+            self._next_ts = buf.pts
+        if buf.pts < self._next_ts:
+            self.stats["drop"] += 1
+            self._prev = buf
+            return None
+        # duplicate previous frame into any gap
+        while self._prev is not None and buf.pts >= self._next_ts + period:
+            dup = self._prev.with_chunks(self._prev.chunks)
+            dup.pts, dup.duration = self._next_ts, period
+            self.stats["dup"] += 1
+            self.stats["out"] += 1
+            self.push(dup)
+            self._next_ts += period
+        out = buf.with_chunks(buf.chunks)
+        out.pts, out.duration = self._next_ts, period
+        self._next_ts += period
+        self._prev = buf
+        self.stats["out"] += 1
+        return out

@@ -1,0 +1,287 @@
+"""tensor_query_client / tensor_query_serversrc / tensor_query_serversink
+— remote-filter (RPC) stream offload.
+
+≙ gst/nnstreamer/tensor_query/*: a client pipeline sends frames to a
+server pipeline and receives results (tensor_query_client.c:676-712 send
+path, :428-510 receive path); server entry/exit pads pair up through a
+shared table keyed by ``id`` so answers return to the asking client
+(tensor_query_server.c). Transport is the edge protocol (edge/protocol.py)
+over TCP/DCN; caps are exchanged at connect like the reference's
+edge-handle info "CAPS" (:537-562).
+"""
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from ..edge.protocol import (MsgKind, buffer_to_wire, recv_msg, send_msg,
+                             wire_to_buffer)
+from ..pipeline.element import Element, SinkElement, SrcElement
+from ..pipeline.pad import Pad
+from ..pipeline.registry import register_element
+from ..tensors.buffer import Buffer
+from ..tensors.caps import Caps
+from ..utils.log import logger
+
+
+class _ServerTable:
+    """Pairs serversrc/serversink by id and routes client connections
+    (≙ GstTensorQueryServerInfo table, tensor_query_server.c)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._conns: Dict[Tuple[int, int], socket.socket] = {}
+        self._out_caps: Dict[int, str] = {}
+
+    def add_conn(self, server_id: int, client_id: int,
+                 sock: socket.socket) -> None:
+        with self._lock:
+            self._conns[(server_id, client_id)] = sock
+
+    def remove_conn(self, server_id: int, client_id: int) -> None:
+        with self._lock:
+            self._conns.pop((server_id, client_id), None)
+
+    def get_conn(self, server_id: int, client_id: int):
+        with self._lock:
+            return self._conns.get((server_id, client_id))
+
+    def set_out_caps(self, server_id: int, caps: str) -> None:
+        with self._lock:
+            self._out_caps[server_id] = caps
+
+    def get_out_caps(self, server_id: int) -> Optional[str]:
+        with self._lock:
+            return self._out_caps.get(server_id)
+
+
+SERVER_TABLE = _ServerTable()
+_FLEX_CAPS = "other/tensors,format=flexible"
+
+
+@register_element("tensor_query_serversrc")
+class TensorQueryServerSrc(SrcElement):
+    """Server entry: listens for clients, pushes received frames into the
+    server pipeline with the client id stamped in buffer extras."""
+
+    PROPS = {"host": "localhost", "port": 3001, "id": 0, "timeout": 10.0}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._listener: Optional[socket.socket] = None
+        self._queue = []
+        self._qlock = threading.Condition()
+        self._next_client = [0]
+        self._accept_thread: Optional[threading.Thread] = None
+
+    @property
+    def bound_port(self) -> int:
+        return self._listener.getsockname()[1] if self._listener else self.port
+
+    def negotiate_src_caps(self) -> Optional[Caps]:
+        return Caps(_FLEX_CAPS)
+
+    def start(self) -> None:
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((self.host, self.port))
+        self._listener.listen(16)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=f"qsrc-accept:{self.name}",
+            daemon=True)
+        self._accept_thread.start()
+        super().start()
+
+    def stop(self) -> None:
+        super().stop()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+            self._listener = None
+
+    def _accept_loop(self) -> None:
+        while not self._stop_evt.is_set():
+            try:
+                conn, addr = self._listener.accept()
+            except OSError:
+                return
+            cid = self._next_client[0]
+            self._next_client[0] += 1
+            SERVER_TABLE.add_conn(self.id, cid, conn)
+            threading.Thread(target=self._client_loop, args=(conn, cid),
+                             name=f"qsrc-client{cid}:{self.name}",
+                             daemon=True).start()
+
+    def _client_loop(self, conn: socket.socket, cid: int) -> None:
+        try:
+            while not self._stop_evt.is_set():
+                kind, meta, payloads = recv_msg(conn)
+                if kind == MsgKind.CAPS:
+                    out_caps = SERVER_TABLE.get_out_caps(self.id) or _FLEX_CAPS
+                    send_msg(conn, MsgKind.CAPS_ACK,
+                             {"caps": out_caps, "client_id": cid})
+                elif kind == MsgKind.DATA:
+                    buf = wire_to_buffer(meta, payloads)
+                    buf.extras["client_id"] = cid
+                    buf.extras["server_id"] = self.id
+                    with self._qlock:
+                        self._queue.append(buf)
+                        self._qlock.notify_all()
+                elif kind == MsgKind.EOS:
+                    break
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            SERVER_TABLE.remove_conn(self.id, cid)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def create(self) -> Optional[Buffer]:
+        with self._qlock:
+            while not self._queue:
+                if self._stop_evt.is_set():
+                    return None
+                self._qlock.wait(timeout=0.1)
+            return self._queue.pop(0)
+
+
+@register_element("tensor_query_serversink")
+class TensorQueryServerSink(SinkElement):
+    """Server exit: returns results to the client that asked."""
+
+    PROPS = {"id": 0, "timeout": 10.0}
+
+    def on_sink_caps(self, pad: Pad, caps: Caps) -> None:
+        SERVER_TABLE.set_out_caps(self.id, str(caps))
+
+    def handle_event(self, pad, event) -> None:
+        from ..pipeline.events import CapsEvent
+        if isinstance(event, CapsEvent):
+            pad.set_caps(event.caps)
+            self.on_sink_caps(pad, event.caps)
+            return
+        super().handle_event(pad, event)
+
+    def render(self, buf: Buffer) -> None:
+        cid = buf.extras.get("client_id")
+        sid = buf.extras.get("server_id", self.id)
+        conn = SERVER_TABLE.get_conn(sid, cid) if cid is not None else None
+        if conn is None:
+            logger.warning("%s: no connection for client %s", self.name, cid)
+            return
+        meta, payloads = buffer_to_wire(buf)
+        meta["client_id"] = cid
+        try:
+            send_msg(conn, MsgKind.RESULT, meta, payloads)
+        except (ConnectionError, OSError):
+            SERVER_TABLE.remove_conn(sid, cid)
+
+
+@register_element("tensor_query_client")
+class TensorQueryClient(Element):
+    """Client: sink-pad frames go to the server; results come back on the
+    src pad. ``timeout`` guards the round trip (≙ timeout property +
+    CONNECTION_CLOSED handling)."""
+
+    SINK_TEMPLATES = {"sink": "other/tensors"}
+    SRC_TEMPLATES = {"src": "other/tensors"}
+    PROPS = {"host": "localhost", "port": 3001, "dest-host": "",
+             "dest-port": 0, "timeout": 10.0, "max-request": 8}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._sock: Optional[socket.socket] = None
+        self._recv_thread: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+        self._inflight = threading.Semaphore(max(1, self.max_request))
+        self._lock = threading.Lock()
+
+    def _target(self) -> Tuple[str, int]:
+        return (self.dest_host or self.host,
+                int(self.dest_port) or int(self.port))
+
+    def start(self) -> None:
+        super().start()
+        self._stop_evt.clear()
+
+    def _connect(self, caps: Optional[Caps]) -> None:
+        host, port = self._target()
+        deadline = time.monotonic() + self.timeout
+        last_err = None
+        while time.monotonic() < deadline:
+            try:
+                self._sock = socket.create_connection((host, port),
+                                                      timeout=self.timeout)
+                break
+            except OSError as e:
+                last_err = e
+                time.sleep(0.05)
+        else:
+            raise ConnectionError(
+                f"{self.name}: cannot connect to {host}:{port}: {last_err}")
+        send_msg(self._sock, MsgKind.CAPS, {"caps": str(caps or "")})
+        kind, meta, _ = recv_msg(self._sock)
+        if kind != MsgKind.CAPS_ACK:
+            raise ConnectionError(f"{self.name}: bad handshake {kind}")
+        self._server_caps = meta.get("caps", _FLEX_CAPS)
+        self._recv_thread = threading.Thread(
+            target=self._recv_loop, name=f"qclient-recv:{self.name}",
+            daemon=True)
+        self._recv_thread.start()
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        super().stop()
+
+    def on_sink_caps(self, pad: Pad, caps: Caps) -> None:
+        if self._sock is None:
+            self._connect(caps)
+        self.set_src_caps(Caps(self._server_caps))
+
+    def do_chain(self, pad: Pad, buf: Buffer) -> None:
+        if self._sock is None:
+            self._connect(pad.caps)
+            self.set_src_caps(Caps(self._server_caps))
+        if not self._inflight.acquire(timeout=self.timeout):
+            raise TimeoutError(f"{self.name}: server not answering")
+        meta, payloads = buffer_to_wire(buf)
+        with self._lock:
+            send_msg(self._sock, MsgKind.DATA, meta, payloads)
+
+    def _recv_loop(self) -> None:
+        try:
+            while not self._stop_evt.is_set():
+                kind, meta, payloads = recv_msg(self._sock)
+                if kind == MsgKind.RESULT:
+                    self._inflight.release()
+                    self.srcpad.push(wire_to_buffer(meta, payloads))
+                elif kind == MsgKind.EOS:
+                    break
+        except (ConnectionError, OSError):
+            if not self._stop_evt.is_set():
+                logger.warning("%s: server connection closed", self.name)
+
+    def on_eos(self) -> None:
+        # drain in-flight requests before forwarding EOS
+        deadline = time.monotonic() + self.timeout
+        for _ in range(max(1, self.max_request)):
+            if not self._inflight.acquire(
+                    timeout=max(0.0, deadline - time.monotonic())):
+                break
+        if self._sock is not None:
+            try:
+                send_msg(self._sock, MsgKind.EOS, {})
+            except (ConnectionError, OSError):
+                pass

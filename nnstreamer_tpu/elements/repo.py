@@ -1,0 +1,105 @@
+"""tensor_repo_sink / tensor_repo_src — in-process repository enabling
+pipeline cycles (recurrent topologies).
+
+≙ gst/nnstreamer/elements/gsttensor_repo{,sink,src}.c: a global slot
+table keyed by ``slot-index`` lets the back of a pipeline feed the front
+without a pad link (LSTM/RNN scaffolds, tests/nnstreamer_repo_lstm).
+"""
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Deque, Dict, Optional
+
+from ..pipeline.element import SinkElement, SrcElement
+from ..pipeline.registry import register_element
+from ..tensors.buffer import Buffer
+from ..tensors.caps import Caps
+
+
+class _Slot:
+    def __init__(self, capacity: int = 2):
+        self.queue: Deque[Buffer] = collections.deque()
+        self.cond = threading.Condition()
+        self.capacity = capacity
+        self.eos = False
+
+
+class TensorRepo:
+    """Global slot table (≙ GstTensorRepo hash + cond-vars)."""
+
+    def __init__(self):
+        self._slots: Dict[int, _Slot] = {}
+        self._lock = threading.Lock()
+
+    def slot(self, index: int) -> _Slot:
+        with self._lock:
+            if index not in self._slots:
+                self._slots[index] = _Slot()
+            return self._slots[index]
+
+    def push(self, index: int, buf: Buffer) -> None:
+        s = self.slot(index)
+        with s.cond:
+            while len(s.queue) >= s.capacity and not s.eos:
+                s.cond.wait(timeout=0.1)
+            s.queue.append(buf)
+            s.cond.notify_all()
+
+    def pop(self, index: int, timeout: Optional[float] = None) -> Optional[Buffer]:
+        s = self.slot(index)
+        with s.cond:
+            deadline = None
+            while not s.queue:
+                if s.eos:
+                    return None
+                if not s.cond.wait(timeout=timeout or 0.1) and timeout:
+                    return None
+            buf = s.queue.popleft()
+            s.cond.notify_all()
+            return buf
+
+    def set_eos(self, index: int) -> None:
+        s = self.slot(index)
+        with s.cond:
+            s.eos = True
+            s.cond.notify_all()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._slots.clear()
+
+
+GLOBAL_REPO = TensorRepo()
+
+
+@register_element("tensor_reposink")
+class TensorRepoSink(SinkElement):
+    PROPS = {"slot-index": 0, "silent": True}
+
+    def render(self, buf: Buffer) -> None:
+        GLOBAL_REPO.push(self.slot_index, buf)
+
+    def on_eos(self) -> None:
+        GLOBAL_REPO.set_eos(self.slot_index)
+        super().on_eos()
+
+
+@register_element("tensor_reposrc")
+class TensorRepoSrc(SrcElement):
+    PROPS = {"slot-index": 0, "caps": "", "silent": True}
+
+    def negotiate_src_caps(self) -> Optional[Caps]:
+        if not self.caps:
+            raise ValueError(f"{self.name}: 'caps' property is required")
+        return Caps(self.caps).fixate()
+
+    def create(self) -> Optional[Buffer]:
+        while not self._stop_evt.is_set():
+            buf = GLOBAL_REPO.pop(self.slot_index, timeout=0.1)
+            if buf is not None:
+                return buf
+            s = GLOBAL_REPO.slot(self.slot_index)
+            if s.eos and not s.queue:
+                return None
+        return None

@@ -1,0 +1,95 @@
+"""tensor_sparse_enc / tensor_sparse_dec — static<->sparse codec.
+
+≙ gst/nnstreamer/elements/gsttensor_sparse{_enc,_dec,_util}.c: non-zero
+elements encoded as (index, value) pairs behind a self-describing
+TensorMetaInfo header (GstSparseTensorInfo.nnz, tensor_typedef.h:294-297).
+
+Wire layout per chunk: 128-byte meta header | uint32 indices[nnz] |
+values[nnz] (element dtype).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+from ..pipeline.element import TransformElement
+from ..pipeline.registry import register_element
+from ..tensors.buffer import Buffer, Chunk
+from ..tensors.caps import Caps
+from ..tensors.info import TensorsConfig, TensorsInfo
+from ..tensors.meta import HEADER_SIZE, TensorMetaInfo
+from ..tensors.types import TensorFormat, TensorType
+
+
+def sparse_encode(arr: np.ndarray) -> bytes:
+    flat = arr.reshape(-1)
+    idx = np.flatnonzero(flat).astype(np.uint32)
+    vals = flat[idx]
+    meta = TensorMetaInfo(
+        type=TensorType.from_dtype(arr.dtype), format=TensorFormat.SPARSE,
+        shape=tuple(arr.shape), nnz=len(idx))
+    return meta.pack() + idx.tobytes() + vals.tobytes()
+
+
+def sparse_decode(data: bytes) -> np.ndarray:
+    meta = TensorMetaInfo.unpack(data[:HEADER_SIZE])
+    if meta.format != TensorFormat.SPARSE:
+        raise ValueError("chunk is not sparse-encoded")
+    nnz = meta.nnz
+    off = HEADER_SIZE
+    idx = np.frombuffer(data[off:off + 4 * nnz], np.uint32)
+    off += 4 * nnz
+    dt = meta.type.np_dtype
+    vals = np.frombuffer(
+        data[off:off + nnz * np.dtype(dt).itemsize], dt)
+    out = np.zeros(math.prod(meta.shape), dt)
+    out[idx] = vals
+    return out.reshape(meta.shape)
+
+
+@register_element("tensor_sparse_enc")
+class TensorSparseEnc(TransformElement):
+    SINK_TEMPLATES = {"sink": "other/tensors"}
+    SRC_TEMPLATES = {"src": "other/tensors"}
+
+    def transform_caps(self, incaps: Caps) -> Optional[Caps]:
+        cfg = incaps.to_config()
+        return Caps.from_config(TensorsConfig(
+            TensorsInfo(), TensorFormat.SPARSE, cfg.rate_n, cfg.rate_d))
+
+    def transform(self, buf: Buffer) -> Optional[Buffer]:
+        chunks = []
+        for c in buf.chunks:
+            data = np.frombuffer(sparse_encode(c.host()), np.uint8)
+            meta = TensorMetaInfo.unpack(data[:HEADER_SIZE].tobytes())
+            chunks.append(Chunk(data, meta=meta))
+        return buf.with_chunks(chunks)
+
+
+@register_element("tensor_sparse_dec")
+class TensorSparseDec(TransformElement):
+    SINK_TEMPLATES = {"sink": "other/tensors"}
+    SRC_TEMPLATES = {"src": "other/tensors"}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._out_cfg: Optional[TensorsConfig] = None
+
+    def transform_caps(self, incaps: Caps) -> Optional[Caps]:
+        cfg = incaps.to_config()
+        # dims are locked from the first decoded buffer (sparse streams are
+        # self-describing); until then advertise flexible
+        self._rate = (cfg.rate_n, cfg.rate_d)
+        return Caps.from_config(TensorsConfig(
+            TensorsInfo(), TensorFormat.FLEXIBLE, cfg.rate_n, cfg.rate_d))
+
+    def transform(self, buf: Buffer) -> Optional[Buffer]:
+        chunks = [Chunk(sparse_decode(c.host().tobytes())) for c in buf.chunks]
+        out = buf.with_chunks(chunks)
+        if self._out_cfg is None:
+            self._out_cfg = TensorsConfig(out.to_infos(), TensorFormat.STATIC,
+                                          *self._rate)
+            self.set_src_caps(Caps.from_config(self._out_cfg))
+        return out

@@ -1,0 +1,156 @@
+"""LLM generative filter — async token streaming on the JAX decode loop.
+
+≙ ext/nnstreamer/tensor_filter/tensor_filter_llamacpp.cc: 1 prompt in,
+N token frames out via the async dispatcher
+(nnstreamer_filter_dispatch_output_async, tensor_filter.c:1099-1170).
+Here generation is the KV-cache decode loop of models/transformer.py —
+static shapes, one jitted decode step reused every token.
+
+model accepts ``zoo://gpt?...`` (zoo spec) or a ``get_lm()`` python file
+returning (params, cfg). custom properties (``custom=key:value,...``):
+max_tokens, temperature (0 = greedy), seed, max_len.
+"""
+from __future__ import annotations
+
+import threading
+import urllib.parse
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..tensors.info import TensorsInfo
+from ..utils.log import logger
+from .base import FilterFramework, FilterProperties
+from .registry import register_alias, register_filter
+
+
+def _parse_custom(s: str) -> Dict[str, str]:
+    out = {}
+    for part in (s or "").split(","):
+        if ":" in part:
+            k, v = part.split(":", 1)
+            out[k.strip()] = v.strip()
+    return out
+
+
+@register_filter
+class LlmFilter(FilterFramework):
+    NAME = "llm"
+    EXTENSIONS = (".gguf",)  # reference auto-detect parity (llamacpp slot)
+
+    def __init__(self):
+        self._params = None
+        self._cfg = None
+        self._decode = None
+        self._opts: Dict[str, str] = {}
+        self._threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+
+    def open(self, props: FilterProperties) -> None:
+        import jax
+
+        from ..models import transformer as tfm
+
+        model = props.model_files[0] if props.model_files else ""
+        if model.startswith("zoo://"):
+            parsed = urllib.parse.urlparse(model)
+            kwargs = {k: v[0] for k, v in
+                      urllib.parse.parse_qs(parsed.query).items()}
+            name = parsed.netloc or parsed.path.lstrip("/")
+            if name != "gpt":
+                raise ValueError(f"llm filter expects zoo://gpt, got {name}")
+            self._cfg = tfm.GPTConfig(
+                vocab=int(kwargs.get("vocab", "32000")),
+                d_model=int(kwargs.get("d_model", "512")),
+                n_heads=int(kwargs.get("n_heads", "8")),
+                n_layers=int(kwargs.get("n_layers", "6")))
+            self._params = tfm.init_params(
+                self._cfg, jax.random.PRNGKey(int(kwargs.get("seed", "0"))))
+        elif model.endswith(".py"):
+            ns: Dict[str, Any] = {}
+            with open(model) as f:
+                exec(compile(f.read(), model, "exec"), ns)  # noqa: S102 — user script
+            self._params, self._cfg = ns["get_lm"]()
+        else:
+            raise ValueError(f"llm filter cannot load model {model!r}")
+        self._opts = _parse_custom(props.custom_properties)
+        cfg = self._cfg
+
+        def step(params, cache, token):
+            return tfm.decode_step(params, cache, token, cfg)
+
+        self._decode = jax.jit(step)
+        self._tfm = tfm
+        self._stop.clear()
+
+    def close(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5.0)
+        self._threads.clear()
+        self._params = None
+        self._decode = None
+
+    def get_model_info(self):
+        # prompt length is per-buffer (dynamic): input derives from caps
+        return None, TensorsInfo.make("int32", "1")
+
+    def set_input_info(self, info: TensorsInfo) -> Optional[TensorsInfo]:
+        return TensorsInfo.make("int32", "1")
+
+    # -- generation -------------------------------------------------------
+    def _generate(self, prompt: np.ndarray, emit) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        max_tokens = int(self._opts.get("max_tokens", "16"))
+        temperature = float(self._opts.get("temperature", "0"))
+        max_len = int(self._opts.get("max_len",
+                                     str(len(prompt) + max_tokens)))
+        key = jax.random.PRNGKey(int(self._opts.get("seed", "0")))
+        cache = self._tfm.init_cache(self._cfg, batch=1, max_len=max_len)
+        logits = None
+        prompt = prompt.reshape(-1)
+        for t in prompt:
+            logits, cache = self._decode(
+                self._params, cache, jnp.asarray([t], jnp.int32))
+        pos = len(prompt)  # host-side cache index: no per-token device sync
+        for i in range(max_tokens):
+            if self._stop.is_set():
+                return
+            if temperature > 0:
+                key, sub = jax.random.split(key)
+                tok = jax.random.categorical(sub, logits / temperature, -1)
+            else:
+                tok = jnp.argmax(logits, -1)
+            emit(np.asarray(tok, np.int32))
+            if i + 1 >= max_tokens or pos >= max_len:
+                return  # nothing left to decode: skip the trailing step
+            logits, cache = self._decode(self._params, cache,
+                                         tok.astype(jnp.int32))
+            pos += 1
+
+    def invoke(self, inputs: Sequence[Any]) -> List[Any]:
+        """Sync path: return the whole generation as one int32 tensor."""
+        tokens: List[np.ndarray] = []
+        self._generate(np.asarray(inputs[0]), tokens.append)
+        return [np.concatenate(tokens) if tokens
+                else np.zeros((0,), np.int32)]
+
+    def invoke_async(self, inputs: Sequence[Any]) -> None:
+        """1-in/N-out: one output frame per generated token."""
+        prompt = np.asarray(inputs[0])
+
+        def run():
+            try:
+                self._generate(prompt, lambda tok: self._dispatch([tok]))
+            except Exception:  # noqa: BLE001
+                logger.exception("llm generation failed")
+
+        t = threading.Thread(target=run, name="llm-generate", daemon=True)
+        self._threads.append(t)
+        t.start()
+
+
+register_alias("llamacpp", "llm")
+register_alias("llama2c", "llm")

@@ -1,0 +1,122 @@
+"""MobileNet-v2 — the benchmark north-star model (flax.linen).
+
+The reference's headline accuracy/golden pipeline is MobileNet-v1/v2 quant
+TFLite image labeling (ref: tests/nnstreamer_filter_tensorflow2_lite/
+runTest.sh:77-80, models in tests/test_models/models/). Here the model is a
+native flax module compiled by XLA for the MXU: convolutions run in
+bfloat16, the classifier emits float32 logits.
+
+Zoo entry: ``model=zoo://mobilenet_v2?width=1.0&num_classes=1001``.
+apply_fn takes one unbatched uint8 HWC frame (the pipeline's per-buffer
+invoke model) and returns a [num_classes] logit vector.
+"""
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..tensors.info import TensorsInfo
+from .zoo import register_model
+
+# (expansion t, channels c, repeats n, stride s) — the standard v2 table
+_V2_BLOCKS: Sequence[Tuple[int, int, int, int]] = (
+    (1, 16, 1, 1),
+    (6, 24, 2, 2),
+    (6, 32, 3, 2),
+    (6, 64, 4, 2),
+    (6, 96, 3, 1),
+    (6, 160, 3, 2),
+    (6, 320, 1, 1),
+)
+
+
+def _make_divisible(v: float, divisor: int = 8) -> int:
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class ConvBN(nn.Module):
+    features: int
+    kernel: Tuple[int, int] = (1, 1)
+    strides: Tuple[int, int] = (1, 1)
+    groups: int = 1
+    act: bool = True
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(self.features, self.kernel, self.strides, padding="SAME",
+                    feature_group_count=self.groups, use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.99,
+                         epsilon=1e-3, dtype=self.dtype)(x)
+        if self.act:
+            x = jnp.minimum(jax.nn.relu(x), 6.0)  # relu6
+        return x
+
+
+class InvertedResidual(nn.Module):
+    features: int
+    strides: Tuple[int, int]
+    expand: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        inp = x.shape[-1]
+        h = x
+        if self.expand != 1:
+            h = ConvBN(inp * self.expand, dtype=self.dtype)(h, train)
+        h = ConvBN(inp * self.expand if self.expand != 1 else inp,
+                   kernel=(3, 3), strides=self.strides,
+                   groups=h.shape[-1], dtype=self.dtype)(h, train)
+        h = ConvBN(self.features, act=False, dtype=self.dtype)(h, train)
+        if self.strides == (1, 1) and inp == self.features:
+            h = h + x
+        return h
+
+
+class MobileNetV2(nn.Module):
+    num_classes: int = 1001
+    width: float = 1.0
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        c0 = _make_divisible(32 * self.width)
+        x = ConvBN(c0, kernel=(3, 3), strides=(2, 2), dtype=self.dtype)(x, train)
+        for t, c, n, s in _V2_BLOCKS:
+            ch = _make_divisible(c * self.width)
+            for i in range(n):
+                x = InvertedResidual(
+                    ch, (s, s) if i == 0 else (1, 1), t, dtype=self.dtype)(x, train)
+        last = _make_divisible(1280 * max(1.0, self.width))
+        x = ConvBN(last, dtype=self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x.astype(jnp.float32))
+        return x
+
+
+@register_model("mobilenet_v2")
+def _build_mobilenet_v2(width: str = "1.0", num_classes: str = "1001",
+                        size: str = "224", seed: str = "0"):
+    """uint8 HWC frame in, float32 logits out; preprocessing ((x/127.5)-1)
+    is fused into the jitted graph so H2D moves uint8, not float."""
+    w, nc, hw = float(width), int(num_classes), int(size)
+    model = MobileNetV2(num_classes=nc, width=w)
+    dummy = jnp.zeros((1, hw, hw, 3), jnp.bfloat16)
+    variables = model.init(jax.random.PRNGKey(int(seed)), dummy)
+
+    def apply_fn(params, frame):
+        x = frame.astype(jnp.bfloat16) / 127.5 - 1.0
+        logits = model.apply(params, x[None])
+        return logits[0]
+
+    in_info = TensorsInfo.make("uint8", f"3:{hw}:{hw}")
+    out_info = TensorsInfo.make("float32", str(nc))
+    return apply_fn, variables, in_info, out_info

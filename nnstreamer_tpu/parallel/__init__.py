@@ -1,0 +1,14 @@
+"""Distributed execution: device meshes, sharding rules, ring attention,
+and the sharded training step.
+
+This package fills the reference's distributed slot (nnstreamer-edge TCP/
+MQTT-hybrid fan-out, SURVEY.md §2.4) the TPU way: intra-pod scale is a
+``jax.sharding.Mesh`` with XLA collectives over ICI; sequence parallelism
+is first-class via ring attention (parallel/ring.py); cross-host streaming
+stays in the query/edge elements (elements/query.py) over DCN sockets.
+"""
+from .mesh import best_mesh, make_mesh
+from .sharding import GPT_RULES, named_sharding_tree, pspec_tree
+
+__all__ = ["make_mesh", "best_mesh", "pspec_tree", "named_sharding_tree",
+           "GPT_RULES"]

@@ -1,0 +1,55 @@
+"""Device-mesh construction helpers.
+
+The mesh axes follow the scaling-book convention: ``data`` (batch /
+fully-replicated gradients via psum), ``seq`` (sequence/context
+parallelism — ring attention neighbors should be ICI neighbors), and
+``model`` (tensor parallelism). Multi-host meshes come from
+``jax.devices()`` spanning hosts; XLA routes collectives over ICI within a
+slice and DCN across slices.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+AXES = ("data", "seq", "model")
+
+
+def make_mesh(shape: Sequence[int], axis_names: Sequence[str] = AXES,
+              devices=None) -> Mesh:
+    """Mesh of the given logical shape; devices default to all local."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = int(np.prod(shape))
+    if n > len(devices):
+        raise ValueError(f"mesh {tuple(shape)} needs {n} devices, "
+                         f"have {len(devices)}")
+    try:
+        arr = mesh_utils.create_device_mesh(tuple(shape), devices[:n])
+    except Exception:  # CPU/virtual devices: no topology info, plain reshape
+        arr = np.array(devices[:n]).reshape(tuple(shape))
+    return Mesh(arr, tuple(axis_names))
+
+
+def best_mesh(n_devices: Optional[int] = None, model_parallel: int = 0,
+              seq_parallel: int = 0) -> Mesh:
+    """Factor n into (data, seq, model).
+
+    Defaults: model axis gets 2 when n is even (exercises tp collectives),
+    seq gets 2 when 4 | n, data takes the rest. Explicit sizes override.
+    """
+    n = n_devices if n_devices is not None else len(jax.devices())
+    tp = model_parallel or (2 if n % 2 == 0 else 1)
+    rest = n // tp
+    sp = seq_parallel or (2 if rest % 2 == 0 and rest >= 2 else 1)
+    dp = rest // sp
+    if dp * sp * tp != n:
+        raise ValueError(f"cannot factor {n} into dp*sp*tp = {dp}*{sp}*{tp}")
+    return make_mesh((dp, sp, tp))
+
+
+def factorization(mesh: Mesh) -> Tuple[int, int, int]:
+    return tuple(mesh.shape[a] for a in mesh.axis_names)  # type: ignore

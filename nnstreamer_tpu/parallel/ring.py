@@ -1,0 +1,102 @@
+"""Ring attention: exact causal attention with the sequence dim sharded
+across a mesh axis.
+
+Long-context is first-class here (the reference handles long prompts only
+inside llama.cpp's own context, SURVEY.md §5 "long-context" note; on TPU
+sequence parallelism is a framework feature). Each device holds one block
+of Q/K/V along the sequence; K/V blocks rotate around the ring via
+``jax.lax.ppermute`` (ICI neighbor exchange) while a flash-style online
+softmax accumulates the exact result — memory per device stays
+O(block²) instead of O(S²).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _block_attend(q, k, v, q_pos, k_pos, o, m, l):
+    """One online-softmax accumulation step.
+
+    q: [B,T,H,D]; k/v: [B,T,H,D]; *_pos: [T] global positions;
+    carry o: [B,T,H,D] f32, m/l: [B,H,T] f32 running max / denominator.
+    """
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    mask = q_pos[None, None, :, None] >= k_pos[None, None, None, :]
+    s = jnp.where(mask, s, -jnp.inf)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # fully-masked rows keep m == -inf; guard exp against nan
+    safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(s - safe_m[..., None])
+    p = jnp.where(mask, p, 0.0)
+    alpha = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v).astype(jnp.float32)
+    o_new = o * alpha.transpose(0, 2, 1)[..., None] + pv
+    return o_new, m_new, l_new
+
+
+def ring_attention_local(q, k, v, axis_name: str):
+    """Per-shard ring attention body (call inside shard_map).
+
+    q/k/v: local blocks [B, T, H, D]; sequence axis sharded over
+    ``axis_name``. Returns the local output block [B, T, H, D].
+    """
+    idx = jax.lax.axis_index(axis_name)
+    n = jax.lax.psum(1, axis_name)
+    t = q.shape[1]
+    q_pos = idx * t + jnp.arange(t)
+    b, _, h, d = q.shape
+    o0 = jnp.zeros((b, t, h, d), jnp.float32)
+    m0 = jnp.full((b, h, t), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, t), jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(carry, step):
+        o, m, l, k_blk, v_blk, src = carry
+        k_pos = src * t + jnp.arange(t)
+        o, m, l = _block_attend(q, k_blk, v_blk, q_pos, k_pos, o, m, l)
+        # rotate: our block moves to the next device; we receive the
+        # previous device's (ICI neighbor exchange)
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        src = (src - 1) % n
+        return (o, m, l, k_blk, v_blk, src), None
+
+    (o, m, l, _, _, _), _ = jax.lax.scan(
+        body, (o0, m0, l0, k, v, idx), None, length=n)
+    l = jnp.where(l == 0.0, 1.0, l)  # rows with no visible keys (shouldn't occur causally)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh: Mesh, data_axis: Optional[str],
+                           seq_axis: str, model_axis: Optional[str]):
+    """shard_map wrapper: q/k/v are global [B,S,H,D] arrays (possibly
+    already sharded); B over data, S over seq, heads over model."""
+    da = data_axis if data_axis in mesh.axis_names else None
+    ma = model_axis if model_axis in mesh.axis_names else None
+    spec = P(da, seq_axis, ma, None)
+
+    fn = jax.shard_map(
+        partial(ring_attention_local, axis_name=seq_axis),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)
+
+
+def dense_reference(q, k, v):
+    """Unsharded causal attention for correctness tests."""
+    s = q.shape[1]
+    pos = jnp.arange(s)
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    mask = pos[None, None, :, None] >= pos[None, None, None, :]
+    scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

@@ -1,0 +1,65 @@
+"""Partition rules: regex-over-param-path -> PartitionSpec.
+
+The t5x/maxtext pattern: a param pytree with stable names, a small rule
+table, and NamedShardings derived per mesh. Rules reference logical mesh
+axes by name; axes missing from a mesh are dropped (spec entry -> None),
+so the same rules serve 1-chip, dp-only, and full dp×sp×tp meshes.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Rule = Tuple[str, Sequence[Optional[str]]]
+
+# Megatron-style TP for models/transformer.py param names:
+# column-parallel in-projections, row-parallel out-projections.
+GPT_RULES: List[Rule] = [
+    (r"embed$", ("model", None)),     # vocab-sharded embedding
+    (r"head$", (None, "model")),
+    (r"\bw[qkv]$", (None, "model")),
+    (r"\bwo$", ("model", None)),
+    (r"\bw[13]$", (None, "model")),
+    (r"\bw2$", ("model", None)),
+    (r"ln.*|.*scale$|.*bias$", ()),   # norms: replicated
+]
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def spec_for(path: str, rules: Sequence[Rule],
+             mesh_axes: Sequence[str]) -> P:
+    for pattern, axes in rules:
+        if re.search(pattern, path):
+            return P(*(a if a in mesh_axes else None for a in axes))
+    return P()  # default: replicate
+
+
+def pspec_tree(params: Any, rules: Sequence[Rule], mesh: Mesh) -> Any:
+    """PartitionSpec pytree matching ``params``."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: spec_for(_path_str(path), rules, mesh.axis_names),
+        params)
+
+
+def named_sharding_tree(params: Any, rules: Sequence[Rule], mesh: Mesh) -> Any:
+    return jax.tree.map(lambda s: NamedSharding(mesh, s),
+                        pspec_tree(params, rules, mesh))
+
+
+def shard_params(params: Any, rules: Sequence[Rule], mesh: Mesh) -> Any:
+    """Place a param pytree onto the mesh per the rules (H2D reshard)."""
+    return jax.device_put(params, named_sharding_tree(params, rules, mesh))

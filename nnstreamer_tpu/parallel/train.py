@@ -1,0 +1,71 @@
+"""Sharded training step.
+
+The distributed training-path core: params live sharded on the mesh
+(parallel/sharding.py rules), the batch is sharded on the data/seq axes,
+``jax.jit`` propagates shardings through grad+optimizer so XLA inserts the
+psum/reduce-scatter collectives (scaling-book recipe: annotate inputs, let
+GSPMD place collectives on ICI). The pipeline-facing trainer element
+(elements/trainer.py) drives this via the trainer-subplugin ABI
+(ref: include/nnstreamer_plugin_api_trainer.h).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .sharding import Rule, named_sharding_tree
+
+
+@dataclasses.dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: Any  # scalar int32 array
+
+    def tree_flatten(self):  # registered below
+        return (self.params, self.opt_state, self.step), None
+
+
+jax.tree_util.register_pytree_node(
+    TrainState,
+    lambda s: ((s.params, s.opt_state, s.step), None),
+    lambda _, c: TrainState(*c))
+
+
+def create_train_state(params: Any, optimizer: optax.GradientTransformation,
+                       mesh: Optional[Mesh] = None,
+                       rules: Optional[Any] = None) -> TrainState:
+    """Init optimizer state on-device. With a mesh, params are placed per
+    the rules first and a jitted init lets GSPMD shard the moments like
+    the params they mirror."""
+    if mesh is not None and rules is not None:
+        params = jax.device_put(params, named_sharding_tree(params, rules, mesh))
+    opt_state = jax.jit(optimizer.init)(params)
+    return TrainState(params, opt_state, jnp.zeros((), jnp.int32))
+
+
+def make_train_step(loss_fn: Callable[[Any, Any], jax.Array],
+                    optimizer: optax.GradientTransformation,
+                    donate: bool = True) -> Callable[[TrainState, Any],
+                                                     Tuple[TrainState, jax.Array]]:
+    """loss_fn(params, batch) -> scalar. Returns jitted (state, batch) ->
+    (state, loss). Sharding flows from the input arrays."""
+
+    def step(state: TrainState, batch) -> Tuple[TrainState, jax.Array]:
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        updates, opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def shard_batch(batch, mesh: Mesh, spec: P):
+    """Place a host batch onto the mesh (data/seq sharded)."""
+    return jax.device_put(batch, NamedSharding(mesh, spec))

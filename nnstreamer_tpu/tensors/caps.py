@@ -113,7 +113,7 @@ def _fixate_value(v: Value) -> Value:
 
 def _parse_value(tok: str) -> Value:
     tok = tok.strip()
-    if tok.startswith('"') and tok.endswith('"'):
+    if len(tok) >= 2 and tok[0] == tok[-1] and tok[0] in "\"'":
         return tok[1:-1]
     if tok.startswith("[") and tok.endswith("]"):
         lo, hi = tok[1:-1].split(",", 1)
@@ -135,12 +135,14 @@ def _parse_value(tok: str) -> Value:
 
 def _split_top(s: str) -> List[str]:
     """Split on commas not inside quotes/brackets/braces."""
-    out, depth, quote, cur = [], 0, False, []
+    out, depth, quote, cur = [], 0, None, []
     for ch in s:
-        if ch == '"':
-            quote = not quote
+        if quote:
             cur.append(ch)
-        elif quote:
+            if ch == quote:
+                quote = None
+        elif ch in "\"'":
+            quote = ch
             cur.append(ch)
         elif ch in "[{(":
             depth += 1

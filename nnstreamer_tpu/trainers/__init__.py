@@ -1,4 +1,14 @@
-"""Training subplugins and checkpointing (L3 trainer backend)."""
-from .checkpoint import restore_params, save_params
+"""Training subplugins and checkpointing (trainer backend layer).
 
-__all__ = ["restore_params", "save_params"]
+≙ the reference's trainer-subplugin slot (GstTensorTrainerFramework,
+include/nnstreamer_plugin_api_trainer.h) whose implementation there is
+NNTrainer; here it is JAX/optax (jax_trainer.py) with orbax checkpoints.
+"""
+from .base import (TrainerEvent, TrainerFramework, TrainerProperties,
+                   TrainerStatus, find_trainer, register_trainer)
+from .checkpoint import restore_params, save_params
+from . import jax_trainer  # noqa: F401 — registers the jax trainer
+
+__all__ = ["restore_params", "save_params", "TrainerFramework",
+           "TrainerProperties", "TrainerStatus", "TrainerEvent",
+           "find_trainer", "register_trainer"]

@@ -1,0 +1,201 @@
+"""The JAX/optax trainer subplugin — this framework's NNTrainer analog.
+
+model-config is a python file defining::
+
+    def get_trainer():
+        # returns (loss_fn, params, optimizer)
+        # loss_fn(params, inputs: list[jax.Array], labels: list[jax.Array])
+        #   -> (scalar loss, scalar accuracy)
+        ...
+
+or ``zoo://<name>?...`` for a zoo classifier trained with softmax
+cross-entropy. Samples pushed by tensor_trainer accumulate into
+device batches; epochs run on a background thread over the collected
+training set (the streaming-training model of gsttensor_trainer.c:
+fixed num-training-samples per epoch, epochs loops re-use them).
+Checkpoints go through orbax (trainers/checkpoint.py); on a mesh the
+train step is the sharded one from parallel/train.py.
+"""
+from __future__ import annotations
+
+import queue as _pyqueue
+import threading
+import urllib.parse
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils.log import logger
+from .base import (TrainerEvent, TrainerFramework, TrainerProperties,
+                   TrainerStatus, register_trainer)
+
+
+def _zoo_classifier_trainer(name: str, **kwargs):
+    """Wrap a zoo model as (loss_fn, params, optimizer) for
+    cross-entropy classification (labels = int class or one-hot)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ..models import zoo
+
+    lr = float(kwargs.pop("lr", "1e-3"))  # trainer knob, not a model kwarg
+    apply_fn, params, _, _ = zoo.build(name, **kwargs)
+
+    def loss_fn(p, inputs, labels):
+        logits = jax.vmap(lambda x: apply_fn(p, x))(inputs[0])
+        y = labels[0]
+        if y.ndim > 1 and y.shape[-1] == logits.shape[-1]:
+            targets = jnp.argmax(y, axis=-1)
+        else:
+            targets = y.reshape(-1).astype(jnp.int32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[:, None], axis=-1).mean()
+        acc = jnp.mean(jnp.argmax(logits, -1) == targets)
+        return nll, acc
+
+    return loss_fn, params, optax.adam(lr)
+
+
+@register_trainer
+class JaxTrainer(TrainerFramework):
+    NAME = "jax"
+
+    def __init__(self):
+        self._props: Optional[TrainerProperties] = None
+        self._queue: _pyqueue.Queue = _pyqueue.Queue(maxsize=256)
+        self._thread: Optional[threading.Thread] = None
+        self._status = TrainerStatus()
+        self._status_lock = threading.Lock()
+        self._stop_evt = threading.Event()
+        self._done_evt = threading.Event()
+        self.params = None
+
+    # -- lifecycle --------------------------------------------------------
+    def create(self, props: TrainerProperties) -> None:
+        self._props = props
+        cfg = props.model_config
+        if cfg.startswith("zoo://"):
+            parsed = urllib.parse.urlparse(cfg)
+            kwargs = {k: v[0] for k, v in
+                      urllib.parse.parse_qs(parsed.query).items()}
+            name = parsed.netloc or parsed.path.lstrip("/")
+            self._loss_fn, self.params, self._optimizer = \
+                _zoo_classifier_trainer(name, **kwargs)
+        elif cfg.endswith(".py"):
+            ns: Dict[str, Any] = {}
+            with open(cfg) as f:
+                exec(compile(f.read(), cfg, "exec"), ns)  # noqa: S102 — user model config
+            if "get_trainer" not in ns:
+                raise ValueError(f"{cfg}: must define get_trainer()")
+            self._loss_fn, self.params, self._optimizer = ns["get_trainer"]()
+        else:
+            raise ValueError(f"jax trainer cannot load model-config {cfg!r}")
+        if props.model_load_path:
+            from .checkpoint import restore_params
+            self.params = restore_params(props.model_load_path, self.params)
+
+    def start(self) -> None:
+        self._stop_evt.clear()
+        self._done_evt.clear()
+        self._thread = threading.Thread(target=self._train_loop,
+                                        name="jax-trainer", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=60.0)
+            self._thread = None
+        if self._props and self._props.model_save_path and \
+                self.params is not None:
+            from .checkpoint import save_params
+            save_params(self._props.model_save_path, self.params)
+            logger.info("jax trainer: saved model to %s",
+                        self._props.model_save_path)
+
+    def destroy(self) -> None:
+        self._stop_evt.set()
+
+    # -- data -------------------------------------------------------------
+    def push_data(self, tensors: Sequence[Any]) -> None:
+        while not self._stop_evt.is_set():
+            try:
+                self._queue.put(list(tensors), timeout=0.5)
+                return
+            except _pyqueue.Full:
+                continue
+
+    def get_status(self) -> TrainerStatus:
+        with self._status_lock:
+            return TrainerStatus(**vars(self._status))
+
+    def wait_training_complete(self, timeout: Optional[float] = None) -> bool:
+        return self._done_evt.wait(timeout)
+
+    # -- training loop ----------------------------------------------------
+    def _collect(self, n: int) -> Optional[List[List[np.ndarray]]]:
+        samples = []
+        while len(samples) < n and not self._stop_evt.is_set():
+            try:
+                samples.append(self._queue.get(timeout=0.5))
+            except _pyqueue.Empty:
+                continue
+        return samples if len(samples) == n else None
+
+    def _train_loop(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        assert self._props is not None
+        p = self._props
+        n_in = p.num_inputs
+
+        def batch_of(samples):
+            cols = list(zip(*samples))
+            arrays = [jnp.asarray(np.stack(c)) for c in cols]
+            return arrays[:n_in], arrays[n_in:]
+
+        opt = self._optimizer
+        opt_state = jax.jit(opt.init)(self.params)
+
+        @jax.jit
+        def step(params, opt_state, inputs, labels):
+            (loss, acc), grads = jax.value_and_grad(
+                self._loss_fn, has_aux=True)(params, inputs, labels)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            import optax
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, acc
+
+        @jax.jit
+        def evaluate(params, inputs, labels):
+            return self._loss_fn(params, inputs, labels)
+
+        try:
+            train = self._collect(p.num_training_samples)
+            if train is None:
+                return
+            val = None
+            if p.num_validation_samples:
+                val = self._collect(p.num_validation_samples)
+            for epoch in range(1, p.epochs + 1):
+                if self._stop_evt.is_set():
+                    return
+                inputs, labels = batch_of(train)
+                self.params, opt_state, loss, acc = step(
+                    self.params, opt_state, inputs, labels)
+                vloss = vacc = 0.0
+                if val:
+                    vi, vl = batch_of(val)
+                    vloss, vacc = (float(x) for x in
+                                   evaluate(self.params, vi, vl))
+                with self._status_lock:
+                    self._status = TrainerStatus(
+                        epoch, float(loss), float(acc), vloss, vacc)
+                self._emit(TrainerEvent.EPOCH_COMPLETION, self.get_status())
+            self._emit(TrainerEvent.TRAINING_COMPLETION, self.get_status())
+        except Exception:  # noqa: BLE001
+            logger.exception("jax trainer loop failed")
+        finally:
+            self._done_evt.set()

@@ -6,8 +6,22 @@ dryrun_multichip does. Must run before jax is imported anywhere.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force-set (not setdefault): the sandbox presets JAX_PLATFORMS=axon (the
+# tunneled TPU); tests must stay on the virtual CPU mesh regardless.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+# This environment's XLA CPU defaults to a reduced-precision matmul path
+# (~4e-3 error on f32 dots), which breaks exactness-style assertions
+# (decode-vs-forward, ring-vs-dense). Pin f32 matmuls for tests only;
+# production keeps the platform default (bf16 on the TPU MXU).
+import jax  # noqa: E402  (env vars above must be set first)
+
+# the sandbox's sitecustomize force-sets jax_platforms="axon,cpu" (the
+# tunneled TPU), overriding JAX_PLATFORMS; override it back before any
+# backend initializes so tests get the 8-device virtual CPU mesh
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "float32")

@@ -1,0 +1,387 @@
+"""mux/merge/demux/split/aggregator/if/rate/crop/repo/sparse tests.
+
+Sync-policy goldens transcribed from the reference's documented PTS
+tables (Documentation/synchronization-policies-at-mux-merge.md).
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import Buffer, Chunk, parse_launch
+from nnstreamer_tpu.pipeline.registry import make_element
+from nnstreamer_tpu.tensors.caps import Caps
+from nnstreamer_tpu.tensors.info import TensorsConfig, TensorsInfo
+
+
+def _caps_for(arr):
+    info = TensorsInfo(Buffer.from_arrays([arr]).to_infos())
+    return Caps.from_config(TensorsConfig(info, rate_n=30, rate_d=1))
+
+
+def _mux_pipeline(sync_mode, sync_option=""):
+    opt = f" sync-option={sync_option}" if sync_option else ""
+    desc = (f'tensor_mux name=m sync-mode={sync_mode}{opt} '
+            '! appsink name=out '
+            'appsrc name=a caps="other/tensors,format=static,num_tensors=1,'
+            'types=(string)int32,dimensions=(string)1,framerate=30/1" '
+            '! m.sink_0 '
+            'appsrc name=b caps="other/tensors,format=static,num_tensors=1,'
+            'types=(string)int32,dimensions=(string)1,framerate=10/1" '
+            '! m.sink_1')
+    return parse_launch(desc)
+
+
+def _buf(val, pts):
+    return Buffer([Chunk(np.array([val], np.int32))], pts=pts)
+
+
+def test_mux_nosync():
+    pipe = _mux_pipeline("nosync")
+    pipe.start()
+    a, b = pipe["a"], pipe["b"]
+    for i in range(3):
+        a.push_buffer(_buf(i, i * 100))
+        b.push_buffer(_buf(10 + i, i * 300))
+    a.end_stream()
+    b.end_stream()
+    pipe.wait_eos(timeout=30)
+    pipe.stop()
+    out = pipe["out"].buffers
+    assert len(out) == 3
+    vals = [(int(o.chunks[0].host()[0]), int(o.chunks[1].host()[0]))
+            for o in out]
+    assert vals == [(0, 10), (1, 11), (2, 12)]
+    # nosync out pts = max of collected pair
+    assert [o.pts for o in out] == [0, 300, 600]
+    # combined caps: 2 tensors, framerate = min(30,10)
+    cfg = pipe["out"].sinkpad.caps.to_config()
+    assert len(cfg.info) == 2
+    assert cfg.rate_n == 10
+
+
+def test_mux_slowest_drops_fast_pad():
+    """Doc example: 30fps pad vs 10fps pad under slowest -> out at 10fps,
+    fast pad contributes its closest-to-base frame."""
+    pipe = _mux_pipeline("slowest")
+    pipe.start()
+    a, b = pipe["a"], pipe["b"]
+    # fast pad: pts 0,100,200,300,400,500 ; slow pad: 0,300,600
+    for i in range(6):
+        a.push_buffer(_buf(i, i * 100))
+    for i in range(3):
+        b.push_buffer(_buf(10 + i, i * 300))
+    a.end_stream()
+    b.end_stream()
+    pipe.wait_eos(timeout=30)
+    pipe.stop()
+    out = pipe["out"].buffers
+    assert [o.pts for o in out] == [0, 300, 600]
+    vals = [(int(o.chunks[0].host()[0]), int(o.chunks[1].host()[0]))
+            for o in out]
+    # fast pad picks the frame with pts == base each time
+    assert vals == [(0, 10), (3, 11), (5, 12)]
+
+
+def test_mux_basepad():
+    pipe = _mux_pipeline("basepad", "1:150")
+    pipe.start()
+    a, b = pipe["a"], pipe["b"]
+    for i in range(6):
+        a.push_buffer(_buf(i, i * 100))
+    for i in range(3):
+        b.push_buffer(_buf(10 + i, i * 300))
+    a.end_stream()
+    b.end_stream()
+    pipe.wait_eos(timeout=30)
+    pipe.stop()
+    out = pipe["out"].buffers
+    # base pad = sink_1 (10fps): output timestamps follow it
+    assert [o.pts for o in out] == [0, 300, 600]
+
+
+def test_mux_refresh():
+    pipe = _mux_pipeline("refresh")
+    pipe.start()
+    a, b = pipe["a"], pipe["b"]
+    a.push_buffer(_buf(0, 0))
+    b.push_buffer(_buf(10, 0))
+    time.sleep(0.2)  # initial collection
+    b.push_buffer(_buf(11, 100))
+    time.sleep(0.2)
+    a.push_buffer(_buf(1, 200))
+    time.sleep(0.2)
+    a.end_stream()
+    b.end_stream()
+    pipe.wait_eos(timeout=30)
+    pipe.stop()
+    out = pipe["out"].buffers
+    vals = [(int(o.chunks[0].host()[0]), int(o.chunks[1].host()[0]))
+            for o in out]
+    # arrival-triggered: initial (0,10), then b refresh (0,11), a refresh (1,11)
+    assert vals[0] == (0, 10)
+    assert (0, 11) in vals and (1, 11) in vals
+
+
+def test_merge_concatenates_dims():
+    desc = ('tensor_merge name=m mode=linear option=0 sync-mode=nosync '
+            '! appsink name=out '
+            'appsrc name=a caps="other/tensors,format=static,num_tensors=1,'
+            'types=(string)float32,dimensions=(string)4,framerate=30/1" '
+            '! m.sink_0 '
+            'appsrc name=b caps="other/tensors,format=static,num_tensors=1,'
+            'types=(string)float32,dimensions=(string)2,framerate=30/1" '
+            '! m.sink_1')
+    pipe = parse_launch(desc)
+    pipe.start()
+    pipe["a"].push_buffer(Buffer.from_arrays(
+        [np.arange(4, dtype=np.float32)], pts=0))
+    pipe["b"].push_buffer(Buffer.from_arrays(
+        [np.array([9., 8.], np.float32)], pts=0))
+    pipe["a"].end_stream()
+    pipe["b"].end_stream()
+    pipe.wait_eos(timeout=30)
+    pipe.stop()
+    out = pipe["out"].buffers
+    assert len(out) == 1
+    np.testing.assert_array_equal(out[0].chunks[0].host(),
+                                  [0, 1, 2, 3, 9, 8])
+    cfg = pipe["out"].sinkpad.caps.to_config()
+    assert cfg.info[0].shape == (6,)
+
+
+def test_demux_tensorpick():
+    pipe = parse_launch(
+        "tensortestsrc pattern=counter num-buffers=2 caps=\"other/tensors,"
+        "format=static,num_tensors=3,types=(string)'int8,int16,int32',"
+        "dimensions=(string)'2,3,4'\" "
+        '! tensor_demux name=d tensorpick=2,0 '
+        'd.src_0 ! appsink name=o1  d.src_1 ! appsink name=o2')
+    pipe.run(timeout=30)
+    o1, o2 = pipe["o1"].buffers, pipe["o2"].buffers
+    assert len(o1) == 2 and len(o2) == 2
+    assert o1[0].chunks[0].dtype == np.int32   # tensor 2
+    assert o2[0].chunks[0].dtype == np.int8    # tensor 0
+    assert pipe["o1"].sinkpad.caps.to_config().info[0].shape == (4,)
+
+
+def test_split_tiles_tensor():
+    pipe = parse_launch(
+        'tensortestsrc pattern=random num-buffers=1 caps="other/tensors,'
+        'format=static,num_tensors=1,types=(string)uint8,'
+        'dimensions=(string)3:4:4" '
+        '! tensor_split name=s tensorseg=1:4:4,2:4:4 '
+        's.src_0 ! appsink name=o1  s.src_1 ! appsink name=o2')
+    pipe.run(timeout=30)
+    a = pipe["o1"].buffers[0].chunks[0].host()
+    b = pipe["o2"].buffers[0].chunks[0].host()
+    assert a.shape == (4, 4, 1) and b.shape == (4, 4, 2)
+
+
+def test_aggregator_window():
+    pipe = parse_launch(
+        'tensortestsrc pattern=counter num-buffers=6 caps="other/tensors,'
+        'format=static,num_tensors=1,types=(string)float32,'
+        'dimensions=(string)2,framerate=(fraction)30/1" '
+        '! tensor_aggregator frames-out=3 frames-flush=3 frames-dim=0 '
+        '! appsink name=out')
+    pipe.run(timeout=30)
+    out = pipe["out"].buffers
+    assert len(out) == 2
+    assert out[0].chunks[0].shape == (6,)
+    np.testing.assert_array_equal(out[0].chunks[0].host(),
+                                  [0, 0, 1, 1, 2, 2])
+
+
+def test_aggregator_sliding_window():
+    pipe = parse_launch(
+        'tensortestsrc pattern=counter num-buffers=4 caps="other/tensors,'
+        'format=static,num_tensors=1,types=(string)float32,'
+        'dimensions=(string)1" '
+        '! tensor_aggregator frames-out=2 frames-flush=1 frames-dim=0 '
+        '! appsink name=out')
+    pipe.run(timeout=30)
+    out = pipe["out"].buffers
+    vals = [tuple(o.chunks[0].host()) for o in out]
+    assert vals == [(0, 1), (1, 2), (2, 3)]
+
+
+def test_tensor_if_average_gate():
+    pipe = parse_launch(
+        'appsrc name=in caps="other/tensors,format=static,num_tensors=1,'
+        'types=(string)float32,dimensions=(string)2" '
+        '! tensor_if name=f compared-value=TENSOR_AVERAGE_VALUE '
+        'compared-value-option=0 operator=GT supplied-value=5 '
+        'then=PASSTHROUGH else=SKIP '
+        'f.src_0 ! appsink name=out')
+    pipe.start()
+    src = pipe["in"]
+    src.push_buffer(Buffer.from_arrays([np.array([10., 10.], np.float32)]))
+    src.push_buffer(Buffer.from_arrays([np.array([1., 1.], np.float32)]))
+    src.push_buffer(Buffer.from_arrays([np.array([8., 8.], np.float32)]))
+    src.end_stream()
+    pipe.wait_eos(timeout=30)
+    pipe.stop()
+    out = pipe["out"].buffers
+    assert len(out) == 2
+    assert [float(o.chunks[0].host()[0]) for o in out] == [10.0, 8.0]
+
+
+def test_tensor_if_custom_condition():
+    from nnstreamer_tpu.elements.flowctl import (register_if_condition,
+                                                 unregister_if_condition)
+    register_if_condition("evens", lambda b: int(b.chunks[0].host()[0]) % 2 == 0)
+    try:
+        pipe = parse_launch(
+            'appsrc name=in caps="other/tensors,format=static,num_tensors=1,'
+            'types=(string)int32,dimensions=(string)1" '
+            '! tensor_if name=f compared-value=CUSTOM '
+            'compared-value-option=evens then=PASSTHROUGH else=SKIP '
+            'f.src_0 ! appsink name=out')
+        pipe.start()
+        for i in range(5):
+            pipe["in"].push_buffer(Buffer.from_arrays(
+                [np.array([i], np.int32)]))
+        pipe["in"].end_stream()
+        pipe.wait_eos(timeout=30)
+        pipe.stop()
+        assert [int(o.chunks[0].host()[0]) for o in pipe["out"].buffers] \
+            == [0, 2, 4]
+    finally:
+        unregister_if_condition("evens")
+
+
+def test_tensor_rate_downsamples():
+    pipe = parse_launch(
+        'tensortestsrc pattern=counter num-buffers=10 caps="other/tensors,'
+        'format=static,num_tensors=1,types=(string)float32,'
+        'dimensions=(string)1,framerate=(fraction)30/1" '
+        '! tensor_rate name=r framerate=10/1 ! appsink name=out')
+    pipe.run(timeout=30)
+    out = pipe["out"].buffers
+    assert 3 <= len(out) <= 4
+    assert pipe["r"].stats["drop"] >= 6
+    cfg = pipe["out"].sinkpad.caps.to_config()
+    assert (cfg.rate_n, cfg.rate_d) == (10, 1)
+
+
+def test_sparse_roundtrip():
+    pipe = parse_launch(
+        'appsrc name=in caps="other/tensors,format=static,num_tensors=1,'
+        'types=(string)float32,dimensions=(string)4:4" '
+        '! tensor_sparse_enc ! tensor_sparse_dec ! appsink name=out')
+    pipe.start()
+    arr = np.zeros((4, 4), np.float32)
+    arr[1, 2] = 5.0
+    arr[3, 0] = -2.0
+    pipe["in"].push_buffer(Buffer.from_arrays([arr]))
+    pipe["in"].end_stream()
+    pipe.wait_eos(timeout=30)
+    pipe.stop()
+    out = pipe["out"].buffers
+    np.testing.assert_array_equal(out[0].chunks[0].host(), arr)
+
+
+def test_sparse_saves_bytes():
+    from nnstreamer_tpu.elements.sparse import sparse_encode
+    arr = np.zeros((100, 100), np.float32)
+    arr[0, 0] = 1.0
+    assert len(sparse_encode(arr)) < arr.nbytes // 10
+
+
+def test_repo_cycle():
+    """Back-of-pipeline feeds front via repository slots (RNN scaffold)."""
+    from nnstreamer_tpu.elements.repo import GLOBAL_REPO
+    GLOBAL_REPO.reset()
+    caps = ('other/tensors,format=static,num_tensors=1,'
+            'types=(string)float32,dimensions=(string)1')
+    sink = parse_launch(
+        f'appsrc name=in caps="{caps}" ! tensor_reposink slot-index=7')
+    src = parse_launch(
+        f'tensor_reposrc slot-index=7 caps="{caps}" ! appsink name=out')
+    src.start()
+    sink.start()
+    for i in range(3):
+        sink["in"].push_buffer(Buffer.from_arrays(
+            [np.array([float(i)], np.float32)]))
+    sink["in"].end_stream()
+    sink.wait_eos(timeout=30)
+    src.wait_eos(timeout=30)
+    sink.stop()
+    src.stop()
+    vals = [float(b.chunks[0].host()[0]) for b in src["out"].buffers]
+    assert vals == [0.0, 1.0, 2.0]
+
+
+def test_crop_with_region_stream():
+    crop = make_element("tensor_crop")
+    raw_pad = crop.sink_pads["raw"]
+    info_pad = crop.sink_pads["info"]
+    from nnstreamer_tpu.pipeline.basic import AppSink
+    sink = AppSink("csink")
+    crop.src_pads["src"].link(sink.sinkpad)
+    frame = np.arange(8 * 8 * 3, dtype=np.uint8).reshape(8, 8, 3)
+    regions = np.array([[2, 2, 4, 4], [0, 0, 2, 2]], np.uint32)
+    crop.do_chain(raw_pad, Buffer.from_arrays([frame]))
+    crop.do_chain(info_pad, Buffer.from_arrays([regions]))
+    out = sink.buffers
+    assert len(out) == 1
+    assert out[0].chunks[0].shape == (4, 4, 3)
+    assert out[0].chunks[1].shape == (2, 2, 3)
+    np.testing.assert_array_equal(out[0].chunks[0].host(), frame[2:6, 2:6])
+
+
+def test_join_first_come():
+    pipe = parse_launch(
+        'join name=j ! appsink name=out '
+        'appsrc name=a caps="other/tensors,format=static,num_tensors=1,'
+        'types=(string)int32,dimensions=(string)1" ! j.sink_0 '
+        'appsrc name=b caps="other/tensors,format=static,num_tensors=1,'
+        'types=(string)int32,dimensions=(string)1" ! j.sink_1')
+    pipe.start()
+    pipe["a"].push_buffer(_buf(1, 0))
+    time.sleep(0.1)
+    pipe["b"].push_buffer(_buf(2, 1))
+    time.sleep(0.1)
+    pipe["a"].end_stream()
+    pipe["b"].end_stream()
+    pipe.wait_eos(timeout=30)
+    pipe.stop()
+    vals = sorted(int(o.chunks[0].host()[0]) for o in pipe["out"].buffers)
+    assert vals == [1, 2]
+
+
+def test_tensor_sink_signals():
+    got = []
+    pipe = parse_launch(
+        'tensortestsrc pattern=counter num-buffers=3 caps="other/tensors,'
+        'format=static,num_tensors=1,types=(string)int32,'
+        'dimensions=(string)1" ! tensor_sink name=ts')
+    pipe["ts"].connect_signal("new-data", lambda b: got.append(b))
+    pipe.run(timeout=30)
+    assert len(got) == 3
+
+
+def test_aggregator_split_mode():
+    """frames-in > frames-out: one batched buffer -> N smaller buffers."""
+    pipe = parse_launch(
+        'tensortestsrc pattern=counter num-buffers=2 caps="other/tensors,'
+        'format=static,num_tensors=1,types=(string)float32,'
+        'dimensions=(string)2:4,framerate=(fraction)10/1" '
+        '! tensor_aggregator frames-in=4 frames-out=2 frames-dim=1 '
+        '! appsink name=out')
+    pipe.run(timeout=30)
+    out = pipe["out"].buffers
+    assert len(out) == 4  # each (4,2) buffer splits into 2 of (2,2)
+    assert out[0].chunks[0].shape == (2, 2)
+    cfg = pipe["out"].sinkpad.caps.to_config()
+    assert cfg.info[0].shape == (2, 2)
+    assert cfg.rate_n == 20
+
+
+def test_pad_sort_key_natural_order():
+    from nnstreamer_tpu.elements.combiner import pad_sort_key
+    names = [f"sink_{i}" for i in range(12)]
+    shuffled = sorted(names)                       # lexicographic scramble
+    assert sorted(shuffled, key=pad_sort_key) == names

@@ -1,0 +1,172 @@
+"""Distributed layer: query client/server round trip and edge pub/sub on
+localhost (the reference's test strategy: multi-process-on-one-host,
+SURVEY.md §4 — here multi-pipeline-in-one-process plus the same protocol
+usable cross-host over DCN).
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import Buffer, parse_launch
+
+
+def _free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+CAPS = ('other/tensors,format=static,num_tensors=1,'
+        'types=(string)float32,dimensions=(string)4')
+
+
+def test_query_round_trip():
+    port = _free_port()
+    # server pipeline: entry -> x2 transform -> exit
+    server = parse_launch(
+        f'tensor_query_serversrc name=qs port={port} id=0 '
+        '! tensor_transform mode=arithmetic option=mul:2.0 '
+        '! tensor_query_serversink id=0')
+    server.start()
+    time.sleep(0.2)
+    client = parse_launch(
+        f'appsrc name=in caps="{CAPS}" '
+        f'! tensor_query_client port={port} timeout=15 '
+        '! appsink name=out')
+    client.start()
+    for i in range(4):
+        client["in"].push_buffer(Buffer.from_arrays(
+            [np.full(4, float(i), np.float32)]))
+    deadline = time.monotonic() + 20
+    while len(client["out"].buffers) < 4 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    client["in"].end_stream()
+    client.stop()
+    server.stop()
+    out = client["out"].buffers
+    assert len(out) == 4
+    for i, b in enumerate(out):
+        np.testing.assert_array_equal(b.chunks[0].host(),
+                                      np.full(4, 2.0 * i, np.float32))
+
+
+def test_query_multiple_clients():
+    port = _free_port()
+    server = parse_launch(
+        f'tensor_query_serversrc port={port} id=1 '
+        '! tensor_transform mode=arithmetic option=add:100.0 '
+        '! tensor_query_serversink id=1')
+    server.start()
+    time.sleep(0.2)
+
+    results = {}
+
+    def run_client(tag, value):
+        c = parse_launch(
+            f'appsrc name=in caps="{CAPS}" '
+            f'! tensor_query_client port={port} timeout=15 '
+            '! appsink name=out')
+        c.start()
+        c["in"].push_buffer(Buffer.from_arrays(
+            [np.full(4, value, np.float32)]))
+        deadline = time.monotonic() + 15
+        while not c["out"].buffers and time.monotonic() < deadline:
+            time.sleep(0.05)
+        results[tag] = [b.chunks[0].host().copy() for b in c["out"].buffers]
+        c["in"].end_stream()
+        c.stop()
+
+    threads = [threading.Thread(target=run_client, args=(i, float(i)))
+               for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    server.stop()
+    # each client got its own answer back (client_id routing)
+    for i in range(3):
+        assert len(results[i]) == 1
+        np.testing.assert_array_equal(results[i][0],
+                                      np.full(4, 100.0 + i, np.float32))
+
+
+def test_edge_pub_sub_fanout():
+    port = _free_port()
+    pub = parse_launch(
+        f'appsrc name=in caps="{CAPS}" '
+        f'! edgesink name=p port={port} topic=t1')
+    pub.start()
+    time.sleep(0.2)
+    subs = [parse_launch(
+        f'edgesrc dest-port={port} topic=t1 timeout=15 ! appsink name=out')
+        for _ in range(2)]
+    for s in subs:
+        s.start()
+    time.sleep(0.3)  # let both subscribers attach
+    for i in range(3):
+        pub["in"].push_buffer(Buffer.from_arrays(
+            [np.full(4, float(i), np.float32)]))
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and \
+            any(len(s["out"].buffers) < 3 for s in subs):
+        time.sleep(0.05)
+    pub["in"].end_stream()
+    for s in subs:
+        s.wait_eos(timeout=15)
+        s.stop()
+    pub.stop()
+    for s in subs:
+        got = [float(b.chunks[0].host()[0]) for b in s["out"].buffers]
+        assert got == [0.0, 1.0, 2.0]
+
+
+def test_edge_topic_mismatch_rejected():
+    port = _free_port()
+    pub = parse_launch(
+        f'appsrc name=in caps="{CAPS}" ! edgesink port={port} topic=a')
+    pub.start()
+    time.sleep(0.2)
+    sub = parse_launch(
+        f'edgesrc dest-port={port} topic=b timeout=2 ! appsink name=out')
+    sub.start()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and sub.bus.drain() == []:
+        time.sleep(0.05)
+    sub.stop()
+    pub["in"].end_stream()
+    pub.stop()
+    assert not sub["out"].buffers
+
+
+def test_remote_filter_offload():
+    """Client pipeline offloads inference to a server running the jax
+    filter (the v5e fan-out seed: BASELINE config 5 semantics)."""
+    port = _free_port()
+    server = parse_launch(
+        f'tensor_query_serversrc port={port} id=2 '
+        '! tensor_filter framework=jax '
+        'model="zoo://mlp?in_dim=4&hidden=8&out_dim=3" '
+        '! tensor_query_serversink id=2')
+    server.start()
+    time.sleep(0.2)
+    client = parse_launch(
+        f'appsrc name=in caps="{CAPS}" '
+        f'! tensor_query_client port={port} timeout=60 '
+        '! appsink name=out')
+    client.start()
+    client["in"].push_buffer(Buffer.from_arrays(
+        [np.ones(4, np.float32)]))
+    deadline = time.monotonic() + 60
+    while not client["out"].buffers and time.monotonic() < deadline:
+        time.sleep(0.05)
+    client["in"].end_stream()
+    client.stop()
+    server.stop()
+    out = client["out"].buffers
+    assert len(out) == 1
+    assert out[0].chunks[0].shape == (3,)

@@ -1,0 +1,278 @@
+"""Converter / transform / decoder element tests (M2 breadth).
+
+Pipelines mirror the reference's SSAT test patterns (videotestsrc !
+tensor_converter ! ... ! sink, golden-value assertions on the sink).
+"""
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import Buffer, Chunk, parse_launch
+from nnstreamer_tpu.tensors.types import TensorType
+
+
+def _run(desc, timeout=30):
+    pipe = parse_launch(desc)
+    pipe.run(timeout=timeout)
+    return pipe
+
+
+# -- tensor_converter --------------------------------------------------------
+
+def test_video_to_tensor():
+    pipe = _run(
+        'videotestsrc pattern=counter num-buffers=3 '
+        'caps="video/x-raw,format=RGB,width=8,height=6,framerate=30/1" '
+        '! tensor_converter ! appsink name=out')
+    bufs = pipe["out"].buffers
+    assert len(bufs) == 3
+    assert bufs[0].chunks[0].shape == (6, 8, 3)
+    assert bufs[0].chunks[0].dtype == np.uint8
+    caps = pipe["out"].sinkpad.caps
+    cfg = caps.to_config()
+    assert cfg.info[0].shape == (6, 8, 3)
+    assert cfg.rate_n == 30
+    # PTS synthesized from framerate
+    assert bufs[1].pts - bufs[0].pts == pytest.approx(1e9 / 30, rel=1e-3)
+
+
+def test_audio_to_tensor():
+    pipe = _run(
+        'audiotestsrc samplesperbuffer=160 num-buffers=2 '
+        'caps="audio/x-raw,format=S16LE,channels=2,rate=16000" '
+        '! tensor_converter ! appsink name=out')
+    bufs = pipe["out"].buffers
+    assert len(bufs) == 2
+    assert bufs[0].chunks[0].shape == (160, 2)
+    assert bufs[0].chunks[0].dtype == np.int16
+
+
+def test_octet_to_tensor_requires_dims():
+    with pytest.raises(Exception):
+        _run('filesrc location=/etc/hostname ! tensor_converter '
+             '! appsink name=out')
+
+
+def test_frames_per_tensor_batches():
+    pipe = _run(
+        'videotestsrc pattern=counter num-buffers=4 '
+        'caps="video/x-raw,format=GRAY8,width=4,height=4,framerate=20/1" '
+        '! tensor_converter frames-per-tensor=2 ! appsink name=out')
+    bufs = pipe["out"].buffers
+    assert len(bufs) == 2
+    assert bufs[0].chunks[0].shape == (2, 4, 4, 1)
+    # counter pattern: frame 0 all-0, frame 1 all-1
+    np.testing.assert_array_equal(
+        bufs[0].chunks[0].host()[:, 0, 0, 0], [0, 1])
+
+
+# -- tensor_transform --------------------------------------------------------
+
+def _push_one(desc, arr):
+    """Run arr through a transform-only pipeline via appsrc."""
+    from nnstreamer_tpu.tensors.caps import Caps
+    from nnstreamer_tpu.tensors.info import TensorsConfig, TensorsInfo
+
+    info = TensorsInfo(Buffer.from_arrays([arr]).to_infos())
+    caps = Caps.from_config(TensorsConfig(info))
+    pipe = parse_launch(f'appsrc name=in caps="{caps}" ! {desc} '
+                        '! appsink name=out')
+    pipe.start()
+    pipe["in"].push_buffer(Buffer.from_arrays([arr]))
+    pipe["in"].end_stream()
+    pipe.wait_eos(timeout=30)
+    pipe.stop()
+    out = pipe["out"].buffers
+    assert len(out) == 1
+    return out[0].chunks[0].host(), pipe["out"].sinkpad.caps
+
+
+def test_transform_typecast_and_arithmetic():
+    arr = np.array([[0, 128, 255]], np.uint8)
+    out, caps = _push_one(
+        "tensor_transform mode=arithmetic "
+        "option=typecast:float32,add:-127.5,div:127.5", arr)
+    assert out.dtype == np.float32
+    np.testing.assert_allclose(out, [[-1.0, 0.00392157, 1.0]], atol=1e-5)
+    assert caps.to_config().info[0].type == TensorType.FLOAT32
+
+
+def test_transform_transpose():
+    arr = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    # reference innermost-first "1:0:2": swap the two innermost dims
+    out, caps = _push_one("tensor_transform mode=transpose option=1:0:2", arr)
+    np.testing.assert_array_equal(out, arr.transpose(0, 2, 1))
+    assert caps.to_config().info[0].shape == (2, 4, 3)
+
+
+def test_transform_dimchg():
+    arr = np.zeros((4, 6, 3), np.float32)
+    # dimchg 0:2 : innermost dim (3) moves to position 2 -> (3,4,6)
+    out, _ = _push_one("tensor_transform mode=dimchg option=0:2", arr)
+    assert out.shape == (3, 4, 6)
+
+
+def test_transform_clamp_stand_padding():
+    arr = np.array([-5.0, 0.5, 9.0], np.float32)
+    out, _ = _push_one("tensor_transform mode=clamp option=0:1", arr)
+    np.testing.assert_array_equal(out, [0.0, 0.5, 1.0])
+
+    arr = np.array([1.0, 2.0, 3.0], np.float32)
+    out, _ = _push_one("tensor_transform mode=stand option=dc-average", arr)
+    np.testing.assert_allclose(out, [-1.0, 0.0, 1.0], atol=1e-6)
+
+    arr = np.ones((2, 2), np.float32)
+    out, caps = _push_one("tensor_transform mode=padding option=1,1,0", arr)
+    assert out.shape == (2, 4)  # pad innermost dim (ref dim 0)
+    assert caps.to_config().info[0].shape == (2, 4)
+
+
+def test_transform_device_resident():
+    """Device chunks stay device-resident through tensor_transform."""
+    import jax.numpy as jnp
+    arr = jnp.asarray(np.arange(6, dtype=np.float32))
+    from nnstreamer_tpu.pipeline.registry import make_element
+    t = make_element("tensor_transform", mode="arithmetic", option="mul:2.0")
+    t.start()
+    out = t.transform(Buffer([Chunk(arr)]))
+    assert out.chunks[0].is_device
+    np.testing.assert_array_equal(out.chunks[0].host(),
+                                  np.arange(6, dtype=np.float32) * 2)
+
+
+# -- tensor_decoder ----------------------------------------------------------
+
+def test_decoder_direct_video():
+    pipe = _run(
+        'tensortestsrc pattern=random num-buffers=2 caps="other/tensors,'
+        'format=static,num_tensors=1,types=(string)uint8,'
+        'dimensions=(string)3:8:6,framerate=(fraction)10/1" '
+        '! tensor_decoder mode=direct_video ! appsink name=out')
+    bufs = pipe["out"].buffers
+    assert len(bufs) == 2
+    caps = pipe["out"].sinkpad.caps
+    s = caps.structures[0]
+    assert s.name == "video/x-raw"
+    assert int(s.fields["width"]) == 8 and int(s.fields["height"]) == 6
+
+
+def test_decoder_image_labeling(tmp_path):
+    labels = tmp_path / "labels.txt"
+    labels.write_text("cat\ndog\nbird\n")
+    from nnstreamer_tpu.decoders.registry import find_decoder
+    dec = find_decoder("image_labeling")()
+    dec.set_options([str(labels)] + [""] * 8)
+    out = dec.decode(Buffer.from_arrays(
+        [np.array([0.1, 0.7, 0.2], np.float32)]))
+    assert out.extras["label"] == "dog"
+    assert bytes(out.chunks[0].host()).decode() == "dog"
+
+
+def test_decoder_bounding_boxes_yolov5():
+    from nnstreamer_tpu.decoders.registry import find_decoder
+    dec = find_decoder("bounding_boxes")()
+    dec.set_options(["yolov5", "", "0:0.5:0.5", "64:64", "64:64",
+                     "", "", "", ""])
+    # one strong box at center (cx=.5,cy=.5,w=.25,h=.25), class 1
+    pred = np.zeros((3, 7), np.float32)
+    pred[0] = [0.5, 0.5, 0.25, 0.25, 0.9, 0.1, 0.95]
+    pred[1] = [0.5, 0.5, 0.26, 0.26, 0.8, 0.1, 0.9]   # suppressed by NMS
+    pred[2] = [0.2, 0.2, 0.1, 0.1, 0.05, 0.9, 0.1]    # below conf
+    from nnstreamer_tpu.tensors.info import TensorsConfig, TensorsInfo
+    dec.get_out_caps(TensorsConfig(TensorsInfo.make("float32", "7:3")))
+    out = dec.decode(Buffer.from_arrays([pred]))
+    boxes = out.extras["boxes"]
+    assert len(boxes) == 1
+    assert boxes[0]["class"] == 1
+    frame = out.chunks[0].host()
+    assert frame.shape == (64, 64, 4)
+    assert frame[:, :, 3].any()  # something was drawn
+
+
+def test_decoder_ssd_postprocess():
+    from nnstreamer_tpu.decoders.registry import find_decoder
+    dec = find_decoder("bounding_boxes")()
+    dec.set_options(["mobilenet-ssd-postprocess", "", "", "32:32", "32:32",
+                     "", "", "", ""])
+    boxes = np.array([[0.1, 0.1, 0.5, 0.5], [0, 0, 0, 0]], np.float32)
+    classes = np.array([2, 0], np.float32)
+    scores = np.array([0.9, 0.0], np.float32)
+    count = np.array([1], np.float32)
+    out = dec.decode(Buffer.from_arrays([boxes, classes, scores, count]))
+    assert len(out.extras["boxes"]) == 1
+    assert out.extras["boxes"][0]["class"] == 2
+
+
+def test_decoder_segment_and_pose():
+    from nnstreamer_tpu.decoders.registry import find_decoder
+    seg = find_decoder("image_segment")()
+    seg.set_options([""] * 9)
+    from nnstreamer_tpu.tensors.info import TensorsConfig, TensorsInfo
+    seg.get_out_caps(TensorsConfig(TensorsInfo.make("float32", "5:4:4")))
+    logits = np.zeros((4, 4, 5), np.float32)
+    logits[:2, :, 1] = 5.0  # top half class 1
+    out = seg.decode(Buffer.from_arrays([logits]))
+    cm = out.extras["class_map"]
+    assert (cm[:2] == 1).all() and (cm[2:] == 0).all()
+
+    pose = find_decoder("pose_estimation")()
+    pose.set_options(["32:32", "9:9", "", "0.1", "", "", "", "", ""])
+    pose.get_out_caps(TensorsConfig(TensorsInfo.make("float32", "17:9:9")))
+    hm = np.zeros((9, 9, 17), np.float32)
+    hm[4, 4, :] = 9.0  # all joints at center
+    out = pose.decode(Buffer.from_arrays([hm]))
+    assert len(out.extras["keypoints"]) == 17
+    x, y, s = out.extras["keypoints"][0]
+    assert abs(x - 0.5) < 0.1 and abs(y - 0.5) < 0.1
+
+
+def test_decoder_tensor_region():
+    from nnstreamer_tpu.decoders.registry import find_decoder
+    dec = find_decoder("tensor_region")()
+    dec.set_options(["2", "", "64:64", "", "", "", "", "", ""])
+    boxes = np.array([[0.25, 0.25, 0.75, 0.75]], np.float32)
+    out = dec.decode(Buffer.from_arrays(
+        [boxes, np.array([1], np.float32), np.array([0.8], np.float32),
+         np.array([1], np.float32)]))
+    regions = out.extras["regions"]
+    assert regions.shape == (2, 4)
+    # x,y,w,h in pixels of the 640x480 default? no: 64:64 per option3
+    assert tuple(regions[0]) == (16, 16, 32, 32)
+
+
+def test_custom_decoder_registration():
+    from nnstreamer_tpu.decoders.registry import (register_custom_decoder,
+                                                  unregister_decoder)
+
+    def flip(buf):
+        return Buffer.from_arrays([buf.chunks[0].host()[::-1].copy()])
+
+    register_custom_decoder("flipper", flip,
+                            "other/tensors,format=flexible")
+    try:
+        pipe = parse_launch(
+            'tensortestsrc pattern=counter num-buffers=1 caps="other/tensors,'
+            'format=static,num_tensors=1,types=(string)float32,'
+            'dimensions=(string)4" ! tensor_decoder mode=flipper '
+            '! appsink name=out')
+        pipe.run(timeout=30)
+        assert len(pipe["out"].buffers) == 1
+    finally:
+        unregister_decoder("flipper")
+
+
+# -- end-to-end: mobilenet pipeline (the BASELINE slice, small) -------------
+
+def test_e2e_video_filter_label_pipeline(tmp_path):
+    labels = tmp_path / "labels.txt"
+    labels.write_text("\n".join(f"class{i}" for i in range(11)))
+    pipe = _run(
+        'videotestsrc pattern=random num-buffers=2 '
+        'caps="video/x-raw,format=RGB,width=96,height=96,framerate=10/1" '
+        '! tensor_converter '
+        '! tensor_filter framework=jax '
+        'model="zoo://mobilenet_v2?width=0.35&size=96&num_classes=11" '
+        f'! tensor_decoder mode=image_labeling option1={labels} '
+        '! appsink name=out', timeout=300)
+    bufs = pipe["out"].buffers
+    assert len(bufs) == 2
+    assert bufs[0].extras["label"].startswith("class")

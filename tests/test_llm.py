@@ -1,0 +1,64 @@
+"""Generative filter: async token streaming (≙ llamacpp subplugin tests).
+"""
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import Buffer, parse_launch
+
+ZOO = "zoo://gpt?vocab=64&d_model=32&n_heads=4&n_layers=2"
+CAPS = ('other/tensors,format=static,num_tensors=1,'
+        'types=(string)int32,dimensions=(string)4')
+
+
+def test_llm_sync_generation():
+    from nnstreamer_tpu.filters.registry import find_filter
+    from nnstreamer_tpu.filters.base import FilterProperties
+    fw = find_filter("llm")()
+    fw.open(FilterProperties(model_files=(ZOO,),
+                             custom_properties="max_tokens:5"))
+    out = fw.invoke([np.array([1, 2, 3], np.int32)])
+    assert out[0].shape == (5,)
+    assert out[0].dtype == np.int32
+    fw.close()
+
+
+def test_llm_greedy_is_deterministic():
+    from nnstreamer_tpu.filters.registry import find_filter
+    from nnstreamer_tpu.filters.base import FilterProperties
+    outs = []
+    for _ in range(2):
+        fw = find_filter("llm")()
+        fw.open(FilterProperties(model_files=(ZOO,),
+                                 custom_properties="max_tokens:6"))
+        outs.append(fw.invoke([np.array([5, 9], np.int32)])[0])
+        fw.close()
+    np.testing.assert_array_equal(outs[0], outs[1])
+
+
+def test_llm_async_token_stream_pipeline():
+    """1 prompt in -> N token buffers out through tensor_filter
+    invoke-async (the generative pipeline shape)."""
+    pipe = parse_launch(
+        f'appsrc name=in caps="{CAPS}" '
+        f'! tensor_filter framework=llm model="{ZOO}" invoke-async=true '
+        'custom="max_tokens:4" invoke-dynamic=true '
+        '! appsink name=out')
+    pipe.start()
+    pipe["in"].push_buffer(Buffer.from_arrays(
+        [np.array([1, 2, 3, 4], np.int32)]))
+    deadline = time.monotonic() + 120
+    while len(pipe["out"].buffers) < 4 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    pipe["in"].end_stream()
+    pipe.stop()
+    out = pipe["out"].buffers
+    assert len(out) == 4          # one buffer per generated token
+    for b in out:
+        assert b.chunks[0].shape == (1,)
+
+
+def test_llamacpp_alias():
+    from nnstreamer_tpu.filters.registry import find_filter
+    assert find_filter("llamacpp").NAME == "llm"
