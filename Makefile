@@ -1,0 +1,28 @@
+# Native runtime build (≙ the reference's meson-built C core; here the
+# native pieces are the util lib, the buffer ring, and custom-filter ABI
+# examples — see csrc/).
+CXX ?= g++
+CXXFLAGS ?= -O2 -fPIC -Wall -Wextra -std=c++17
+BUILD := build/native
+
+LIB := $(BUILD)/libnnstpu.so
+EXAMPLES := $(BUILD)/custom_passthrough.so $(BUILD)/custom_scaler.so
+
+.PHONY: native clean test
+
+native: $(LIB) $(EXAMPLES)
+
+$(BUILD):
+	mkdir -p $(BUILD)
+
+$(LIB): csrc/nns_util.cc csrc/nns_ring.cc csrc/nns_custom.h | $(BUILD)
+	$(CXX) $(CXXFLAGS) -shared -o $@ csrc/nns_util.cc csrc/nns_ring.cc
+
+$(BUILD)/custom_%.so: csrc/custom_%.cc csrc/nns_custom.h | $(BUILD)
+	$(CXX) $(CXXFLAGS) -shared -o $@ $<
+
+test: native
+	python -m pytest tests/ -q
+
+clean:
+	rm -rf $(BUILD)

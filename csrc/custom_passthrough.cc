@@ -1,0 +1,47 @@
+/**
+ * custom_passthrough.cc — example native custom filter (.so).
+ *
+ * ≙ tests/nnstreamer_example/custom_example_passthrough: echoes input
+ * tensors unchanged. Doubles as the ABI conformance fixture for
+ * filters/custom_c.py (tests build it with the repo Makefile).
+ */
+#include "nns_custom.h"
+
+#include <cstring>
+
+namespace {
+
+const size_t kElemSize[NNS_TYPE_END] = {4, 4, 2, 2, 1, 1, 8, 4, 8, 8, 2};
+
+uint64_t info_bytes(const nns_tensor_info *info) {
+  uint64_t n = info->rank ? 1 : 0;
+  for (uint32_t i = 0; i < info->rank; ++i) n *= info->dims[i];
+  return n * (info->type >= 0 && info->type < NNS_TYPE_END
+                  ? kElemSize[info->type]
+                  : 0);
+}
+
+void *pt_init(const char * /*props*/) { return (void *)0x1; }
+void pt_exit(void * /*priv*/) {}
+
+int pt_set_input_dim(void * /*priv*/, const nns_tensors_info *in,
+                     nns_tensors_info *out) {
+  std::memcpy(out, in, sizeof(*in));
+  return 0;
+}
+
+int pt_invoke(void * /*priv*/, const nns_tensors_info *in_info,
+              const void *const *in, const nns_tensors_info * /*out_info*/,
+              void *const *out) {
+  for (uint32_t i = 0; i < in_info->num; ++i)
+    std::memcpy(out[i], in[i], info_bytes(&in_info->info[i]));
+  return 0;
+}
+
+const nns_custom_filter kFilter = {
+    pt_init, pt_exit, nullptr, nullptr, pt_set_input_dim, pt_invoke,
+};
+
+} // namespace
+
+extern "C" const nns_custom_filter *nns_custom_get(void) { return &kFilter; }

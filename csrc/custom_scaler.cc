@@ -1,0 +1,54 @@
+/**
+ * custom_scaler.cc — example native custom filter with custom-props.
+ *
+ * ≙ tests/nnstreamer_example/custom_example_scaler: multiplies float32
+ * tensors by a factor given in the custom properties string ("2.0").
+ */
+#include "nns_custom.h"
+
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+struct Priv {
+  float factor;
+};
+
+void *sc_init(const char *props) {
+  Priv *p = new Priv{2.0f};
+  if (props && props[0]) p->factor = std::strtof(props, nullptr);
+  return p;
+}
+
+void sc_exit(void *priv) { delete static_cast<Priv *>(priv); }
+
+int sc_set_input_dim(void * /*priv*/, const nns_tensors_info *in,
+                     nns_tensors_info *out) {
+  std::memcpy(out, in, sizeof(*in));
+  return 0;
+}
+
+int sc_invoke(void *priv, const nns_tensors_info *in_info,
+              const void *const *in, const nns_tensors_info * /*out_info*/,
+              void *const *out) {
+  Priv *p = static_cast<Priv *>(priv);
+  for (uint32_t i = 0; i < in_info->num; ++i) {
+    const nns_tensor_info *info = &in_info->info[i];
+    if (info->type != NNS_FLOAT32) return -1;
+    uint64_t n = info->rank ? 1 : 0;
+    for (uint32_t d = 0; d < info->rank; ++d) n *= info->dims[d];
+    const float *src = static_cast<const float *>(in[i]);
+    float *dst = static_cast<float *>(out[i]);
+    for (uint64_t e = 0; e < n; ++e) dst[e] = src[e] * p->factor;
+  }
+  return 0;
+}
+
+const nns_custom_filter kFilter = {
+    sc_init, sc_exit, nullptr, nullptr, sc_set_input_dim, sc_invoke,
+};
+
+} // namespace
+
+extern "C" const nns_custom_filter *nns_custom_get(void) { return &kFilter; }

@@ -1,0 +1,83 @@
+/**
+ * nns_custom.h — C ABI for native custom filter subplugins.
+ *
+ * The TPU framework's analog of the reference's full C custom-filter ABI
+ * (ref: gst/nnstreamer/tensor_filter/include/tensor_filter_custom.h:46-134
+ * — NNStreamer_custom_class with init/exit/get*Dim/setInputDim/invoke).
+ * A custom .so exports one symbol:
+ *
+ *     const nns_custom_filter *nns_custom_get(void);
+ *
+ * The host (filters/custom_c.py via ctypes, or a future C scheduler)
+ * dlopen()s the .so and drives the callbacks. All memory passed to invoke
+ * is owned by the host; in[] buffers are read-only, out[] buffers are
+ * pre-allocated to the negotiated sizes.
+ */
+#ifndef NNS_CUSTOM_H
+#define NNS_CUSTOM_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define NNS_RANK_LIMIT 16
+#define NNS_TENSOR_LIMIT 16
+
+/* matches nnstreamer_tpu.tensors.types.TensorType ordinals */
+typedef enum {
+  NNS_INT32 = 0,
+  NNS_UINT32,
+  NNS_INT16,
+  NNS_UINT16,
+  NNS_INT8,
+  NNS_UINT8,
+  NNS_FLOAT64,
+  NNS_FLOAT32,
+  NNS_INT64,
+  NNS_UINT64,
+  NNS_FLOAT16,
+  NNS_TYPE_END
+} nns_tensor_type;
+
+typedef struct {
+  uint32_t rank;                       /* valid dims */
+  uint32_t dims[NNS_RANK_LIMIT];       /* innermost-first, 1-padded */
+  int32_t type;                        /* nns_tensor_type */
+} nns_tensor_info;
+
+typedef struct {
+  uint32_t num;
+  nns_tensor_info info[NNS_TENSOR_LIMIT];
+} nns_tensors_info;
+
+typedef struct {
+  /* lifecycle */
+  void *(*init)(const char *custom_props);
+  void (*exit)(void *priv);
+
+  /* static-shape path: report model I/O (return 0 on success) */
+  int (*get_input_dim)(void *priv, nns_tensors_info *in);
+  int (*get_output_dim)(void *priv, nns_tensors_info *out);
+
+  /* negotiation push path: input dims -> output dims (may be NULL if the
+   * static path is implemented, ref: getInputDim XOR setInputDim) */
+  int (*set_input_dim)(void *priv, const nns_tensors_info *in,
+                       nns_tensors_info *out);
+
+  /* hot path */
+  int (*invoke)(void *priv, const nns_tensors_info *in_info,
+                const void *const *in, const nns_tensors_info *out_info,
+                void *const *out);
+} nns_custom_filter;
+
+/* the one exported symbol */
+typedef const nns_custom_filter *(*nns_custom_get_fn)(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* NNS_CUSTOM_H */

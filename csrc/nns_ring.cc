@@ -1,0 +1,106 @@
+/**
+ * nns_ring.cc — bounded MPMC ring queue for buffer handoff (libnnstpu.so).
+ *
+ * Native replacement for the Python queue on the pipeline's thread
+ * boundaries (≙ the reference's reliance on gst queue streaming threads;
+ * the zero-copy buffer ring idea from SURVEY.md §7 design stance).
+ * Carries opaque pointers; blocking push gives backpressure. Exposed via
+ * a C ABI for ctypes (pipeline/basic.py Queue fast path) and native
+ * elements.
+ */
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+struct Ring {
+  explicit Ring(uint32_t cap) : buf(cap), capacity(cap) {}
+  std::vector<void *> buf;
+  uint32_t capacity;
+  uint32_t head = 0; /* pop position */
+  uint32_t count = 0;
+  bool closed = false;
+  std::mutex m;
+  std::condition_variable not_full, not_empty;
+};
+
+} // namespace
+
+extern "C" {
+
+void *nns_ring_new(uint32_t capacity) {
+  if (capacity == 0) capacity = 1;
+  return new Ring(capacity);
+}
+
+void nns_ring_free(void *ring) { delete static_cast<Ring *>(ring); }
+
+/** Close: wakes all waiters; push fails, pop drains then fails. */
+void nns_ring_close(void *ring) {
+  Ring *r = static_cast<Ring *>(ring);
+  {
+    std::lock_guard<std::mutex> lock(r->m);
+    r->closed = true;
+  }
+  r->not_full.notify_all();
+  r->not_empty.notify_all();
+}
+
+/**
+ * Push; blocks while full (timeout_ms < 0 = forever, 0 = try).
+ * Returns 0 ok, 1 would-block/timeout, 2 closed.
+ */
+int nns_ring_push(void *ring, void *item, int64_t timeout_ms) {
+  Ring *r = static_cast<Ring *>(ring);
+  std::unique_lock<std::mutex> lock(r->m);
+  auto full = [r] { return r->count >= r->capacity && !r->closed; };
+  if (full()) {
+    if (timeout_ms == 0) return 1;
+    if (timeout_ms < 0)
+      r->not_full.wait(lock, [&] { return !full(); });
+    else if (!r->not_full.wait_for(lock, std::chrono::milliseconds(timeout_ms),
+                                   [&] { return !full(); }))
+      return 1;
+  }
+  if (r->closed) return 2;
+  r->buf[(r->head + r->count) % r->capacity] = item;
+  ++r->count;
+  lock.unlock();
+  r->not_empty.notify_one();
+  return 0;
+}
+
+/**
+ * Pop into *out; blocks while empty. Returns 0 ok, 1 timeout, 2 closed+empty.
+ */
+int nns_ring_pop(void *ring, void **out, int64_t timeout_ms) {
+  Ring *r = static_cast<Ring *>(ring);
+  std::unique_lock<std::mutex> lock(r->m);
+  auto empty = [r] { return r->count == 0 && !r->closed; };
+  if (empty()) {
+    if (timeout_ms == 0) return 1;
+    if (timeout_ms < 0)
+      r->not_empty.wait(lock, [&] { return !empty(); });
+    else if (!r->not_empty.wait_for(lock, std::chrono::milliseconds(timeout_ms),
+                                    [&] { return !empty(); }))
+      return 1;
+  }
+  if (r->count == 0) return 2; /* closed and drained */
+  *out = r->buf[r->head];
+  r->head = (r->head + 1) % r->capacity;
+  --r->count;
+  lock.unlock();
+  r->not_full.notify_one();
+  return 0;
+}
+
+uint32_t nns_ring_size(void *ring) {
+  Ring *r = static_cast<Ring *>(ring);
+  std::lock_guard<std::mutex> lock(r->m);
+  return r->count;
+}
+
+} /* extern "C" */

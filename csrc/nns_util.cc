@@ -1,0 +1,117 @@
+/**
+ * nns_util.cc — native tensor-info utilities (libnnstpu.so).
+ *
+ * C++ implementations of the glib-free util layer
+ * (ref: gst/nnstreamer/nnstreamer_plugin_api_util_impl.c — dimension
+ * string parse/serialize/compare, element sizes), exported with a C ABI
+ * for ctypes and for native subplugins. The Python tensors/ package is
+ * the source of truth for semantics; these mirror it for native callers
+ * and for hot paths (bulk caps parsing in the stream scheduler).
+ */
+#include "nns_custom.h"
+
+#include <cinttypes>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+static const size_t kElemSize[NNS_TYPE_END] = {
+    4, 4, 2, 2, 1, 1, 8, 4, 8, 8, 2,
+};
+
+static const char *kTypeNames[NNS_TYPE_END] = {
+    "int32",  "uint32",  "int16",  "uint16", "int8", "uint8",
+    "float64", "float32", "int64", "uint64", "float16",
+};
+
+extern "C" {
+
+size_t nns_element_size(int32_t type) {
+  if (type < 0 || type >= NNS_TYPE_END) return 0;
+  return kElemSize[type];
+}
+
+int32_t nns_type_from_string(const char *name) {
+  if (!name) return -1;
+  for (int32_t i = 0; i < NNS_TYPE_END; ++i)
+    if (std::strcmp(kTypeNames[i], name) == 0) return i;
+  return -1;
+}
+
+const char *nns_type_to_string(int32_t type) {
+  if (type < 0 || type >= NNS_TYPE_END) return "";
+  return kTypeNames[type];
+}
+
+/**
+ * Parse "3:224:224" (innermost-first; 0 terminates; trailing 1s padded).
+ * Returns rank, or -1 on error.
+ */
+int nns_parse_dimension(const char *str, uint32_t *dims) {
+  if (!str || !dims) return -1;
+  uint32_t rank = 0;
+  const char *p = str;
+  while (*p && rank < NNS_RANK_LIMIT) {
+    char *end = nullptr;
+    long v = std::strtol(p, &end, 10);
+    if (end == p || v < 0) return -1;
+    if (v == 0) break; /* 0 terminates: remainder unspecified */
+    dims[rank++] = (uint32_t)v;
+    if (*end == '\0') break;
+    if (*end != ':') return -1;
+    p = end + 1;
+  }
+  for (uint32_t i = rank; i < NNS_RANK_LIMIT; ++i) dims[i] = 1;
+  /* strip trailing 1-padding like the python parser */
+  while (rank > 1 && dims[rank - 1] == 1) --rank;
+  return (int)rank;
+}
+
+/** Serialize rank dims into buf ("3:224:224"); returns chars written. */
+int nns_serialize_dimension(const uint32_t *dims, uint32_t rank, char *buf,
+                            size_t buflen) {
+  if (!dims || !buf || buflen == 0) return -1;
+  if (rank == 0) {
+    int n = std::snprintf(buf, buflen, "1");
+    return n;
+  }
+  size_t off = 0;
+  for (uint32_t i = 0; i < rank; ++i) {
+    int n = std::snprintf(buf + off, buflen - off, i ? ":%" PRIu32 : "%" PRIu32,
+                          dims[i]);
+    if (n < 0 || (size_t)n >= buflen - off) return -1;
+    off += (size_t)n;
+  }
+  return (int)off;
+}
+
+uint64_t nns_info_num_elements(const nns_tensor_info *info) {
+  if (!info) return 0;
+  uint64_t n = 1;
+  for (uint32_t i = 0; i < info->rank && i < NNS_RANK_LIMIT; ++i)
+    n *= info->dims[i];
+  return info->rank ? n : 0;
+}
+
+uint64_t nns_info_size_bytes(const nns_tensor_info *info) {
+  if (!info) return 0;
+  return nns_info_num_elements(info) * nns_element_size(info->type);
+}
+
+/** Type+dims equality, names ignored (≙ gst_tensor_info_is_equal). */
+int nns_info_is_equal(const nns_tensor_info *a, const nns_tensor_info *b) {
+  if (!a || !b) return 0;
+  if (a->type != b->type || a->rank != b->rank) return 0;
+  for (uint32_t i = 0; i < a->rank; ++i)
+    if (a->dims[i] != b->dims[i]) return 0;
+  return 1;
+}
+
+int nns_infos_are_equal(const nns_tensors_info *a, const nns_tensors_info *b) {
+  if (!a || !b || a->num != b->num) return 0;
+  for (uint32_t i = 0; i < a->num; ++i)
+    if (!nns_info_is_equal(&a->info[i], &b->info[i])) return 0;
+  return 1;
+}
+
+} /* extern "C" */

@@ -1,0 +1,10 @@
+"""Native runtime bindings (libnnstpu.so via ctypes).
+
+The reference's core is C (SURVEY.md §2.1); this package binds our native
+equivalents — tensor-info utils, the buffer ring, and the custom-filter
+C ABI loader — without pybind11 (not in the image): plain ctypes over a
+stable C ABI (csrc/nns_custom.h).
+"""
+from .lib import NativeRing, load_native_lib, native_available
+
+__all__ = ["load_native_lib", "native_available", "NativeRing"]

@@ -1,0 +1,103 @@
+"""Native runtime: libnnstpu utils, ring queue, and the C custom-filter
+ABI (≙ the reference's C core + custom_example_* fixture subplugins).
+Skipped when no toolchain can build csrc/.
+"""
+import ctypes
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.native.lib import (NativeRing, load_native_lib,
+                                       native_available)
+
+pytestmark = pytest.mark.skipif(not native_available(),
+                                reason="native lib unavailable")
+
+_BUILD = os.path.join(os.path.dirname(__file__), "..", "build", "native")
+
+
+def test_native_dimension_grammar():
+    lib = load_native_lib()
+    dims = (ctypes.c_uint32 * 16)()
+    rank = lib.nns_parse_dimension(b"3:224:224", dims)
+    assert rank == 3
+    assert list(dims[:3]) == [3, 224, 224]
+    # trailing 1-padding stripped, 0 terminates
+    assert lib.nns_parse_dimension(b"3:224:224:1", dims) == 3
+    assert lib.nns_parse_dimension(b"5:0:7", dims) == 1
+    buf = ctypes.create_string_buffer(64)
+    n = lib.nns_serialize_dimension(dims, 3, buf, 64)
+    assert n > 0
+    assert lib.nns_parse_dimension(b"bogus", dims) == -1
+
+
+def test_native_element_size_matches_python():
+    from nnstreamer_tpu.filters.custom_c import _TYPE_ORDER
+    lib = load_native_lib()
+    for i, t in enumerate(_TYPE_ORDER):
+        assert lib.nns_element_size(i) == t.element_size
+
+
+def test_native_ring_backpressure_and_order():
+    ring = NativeRing(2)
+    assert ring.push("a", timeout_ms=100)
+    assert ring.push("b", timeout_ms=100)
+    assert not ring.push("c", timeout_ms=50)  # full: times out
+    assert ring.pop() == "a"
+    assert ring.push("c", timeout_ms=100)
+    assert ring.pop() == "b"
+    assert ring.pop() == "c"
+    assert ring.pop(timeout_ms=50) is None
+
+
+def test_native_ring_cross_thread():
+    ring = NativeRing(4)
+    got = []
+
+    def consumer():
+        while True:
+            item = ring.pop(timeout_ms=2000)
+            if item is None:
+                return
+            got.append(item)
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    for i in range(20):
+        assert ring.push(i)
+    t.join(timeout=5)
+    assert got == list(range(20))
+    ring.close()
+
+
+def test_c_custom_filter_passthrough_pipeline():
+    so = os.path.abspath(os.path.join(_BUILD, "custom_passthrough.so"))
+    from nnstreamer_tpu import Buffer, parse_launch
+    pipe = parse_launch(
+        'tensortestsrc pattern=counter num-buffers=2 caps="other/tensors,'
+        'format=static,num_tensors=1,types=(string)float32,'
+        f'dimensions=(string)4" ! tensor_filter framework=custom model={so} '
+        '! appsink name=out')
+    pipe.run(timeout=30)
+    out = pipe["out"].buffers
+    assert len(out) == 2
+    np.testing.assert_array_equal(out[1].chunks[0].host(),
+                                  np.ones(4, np.float32))
+
+
+def test_c_custom_filter_scaler_with_props():
+    so = os.path.abspath(os.path.join(_BUILD, "custom_scaler.so"))
+    from nnstreamer_tpu.filters.base import FilterProperties
+    from nnstreamer_tpu.filters.registry import find_filter
+    fw = find_filter("custom")()
+    fw.open(FilterProperties(model_files=(so,), custom_properties="3.5"))
+    out = fw.invoke([np.array([1.0, 2.0], np.float32)])
+    np.testing.assert_allclose(out[0], [3.5, 7.0])
+    fw.close()
+
+
+def test_so_extension_autodetects_custom():
+    from nnstreamer_tpu.filters.registry import detect_framework
+    assert detect_framework(("/tmp/whatever.so",)) == "custom"
