@@ -183,16 +183,20 @@ class _CollectBase(Element):
         if mode == "basepad":
             opt = (self.sync_option or "0").split(":")
             base_id = int(opt[0] or 0)
-            duration = int(opt[1]) if len(opt) > 1 and opt[1] else (1 << 62)
             if base_id >= len(sts):
                 return False
             bst = sts[base_id]
             if not bst.queue:
                 return False
             current = bst.queue[0].pts or 0
-            if bst.last is not None:
-                base_win = min(duration,
-                               abs(current - (bst.last.pts or 0)) - 1)
+            # the configured duration IS the window (≙ reference basepad
+            # semantics); fall back to a PTS-delta heuristic only when no
+            # duration was given, clamped >= 0 so equal consecutive base
+            # PTS can't wedge every other pad on stale buffers
+            if len(opt) > 1 and opt[1]:
+                base_win = int(opt[1])
+            elif bst.last is not None:
+                base_win = max(0, abs(current - (bst.last.pts or 0)) - 1)
             else:
                 base_win = 0
         else:

@@ -265,8 +265,11 @@ class TensorQueryClient(Element):
             while not self._stop_evt.is_set():
                 kind, meta, payloads = recv_msg(self._sock)
                 if kind == MsgKind.RESULT:
-                    self._inflight.release()
+                    # push before releasing: on_eos drains by acquiring all
+                    # permits, so releasing first would let EOS overtake
+                    # (and drop) this final result downstream
                     self.srcpad.push(wire_to_buffer(meta, payloads))
+                    self._inflight.release()
                 elif kind == MsgKind.EOS:
                     break
         except (ConnectionError, OSError):

@@ -100,5 +100,7 @@ class TensorTrainer(TransformElement):
     def on_eos(self) -> None:
         """Wait for the training thread before forwarding EOS
         (≙ wait_for_epoch_completion, gsttensor_trainer.c:590)."""
+        if self.fw is not None and hasattr(self.fw, "end_of_data"):
+            self.fw.end_of_data()  # stop waiting on the sample queue
         if self.fw is not None and hasattr(self.fw, "wait_training_complete"):
             self.fw.wait_training_complete(timeout=600.0)

@@ -12,7 +12,8 @@ from typing import Any, List, Optional, Sequence
 
 import numpy as np
 
-from ..native.lib import NnsTensorInfo, NnsTensorsInfo, RANK_LIMIT
+from ..native.lib import (NnsTensorInfo, NnsTensorsInfo, RANK_LIMIT,
+                          TENSOR_LIMIT)
 from ..tensors.info import TensorInfo, TensorsInfo
 from ..tensors.types import TensorType
 from .base import FilterFramework, FilterProperties
@@ -47,11 +48,19 @@ _TYPE_ORDER = [TensorType.INT32, TensorType.UINT32, TensorType.INT16,
 
 
 def _to_c_infos(infos: TensorsInfo) -> NnsTensorsInfo:
+    if len(infos) > TENSOR_LIMIT:
+        raise ValueError(
+            f"custom-C ABI supports at most {TENSOR_LIMIT} tensors, "
+            f"got {len(infos)} (nns_custom.h NNS_TENSOR_LIMIT)")
     out = NnsTensorsInfo()
     out.num = len(infos)
     for i, info in enumerate(infos):
         ci = out.info[i]
         dims = list(reversed(info.shape))  # innermost-first
+        if len(dims) > RANK_LIMIT:
+            raise ValueError(
+                f"custom-C ABI supports rank <= {RANK_LIMIT}, got "
+                f"{len(dims)} (nns_custom.h NNS_RANK_LIMIT)")
         ci.rank = len(dims)
         for d in range(RANK_LIMIT):
             ci.dims[d] = dims[d] if d < len(dims) else 1

@@ -9,7 +9,7 @@ lists; nnstreamer_plugin_api_filter.h:560-598 nnstreamer_filter_shared_model_*).
 Instead of dlopen'd .so self-registration, backends register via
 ``@register_filter`` at import time; out-of-tree backends can use Python
 entry points or plain imports. C custom filters load via ctypes
-(filters/cffi_custom.py).
+(filters/custom_c.py).
 """
 from __future__ import annotations
 

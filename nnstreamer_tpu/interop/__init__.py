@@ -1,0 +1,13 @@
+"""Interop: importers for foreign model formats and wire codecs.
+
+The reference ships ~30 native backend subplugins (ext/nnstreamer/
+tensor_filter/). On TPU they collapse into importers: each foreign format
+is parsed host-side and lowered to one jittable JAX function, so every
+model — whatever its origin — runs through the same XLA path. Modules:
+
+- flatbuf: minimal generic FlatBuffers reader (no codegen, no deps)
+- tflite: .tflite model parser + op-by-op lowering to JAX
+  (≙ ext/nnstreamer/tensor_filter/tensor_filter_tensorflow_lite.cc)
+- onnx: .onnx protobuf parser + lowering
+  (≙ ext/nnstreamer/tensor_filter/tensor_filter_onnxruntime.cc)
+"""

@@ -57,7 +57,10 @@ def load_native_lib() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_LIB_PATH) and not _try_build():
+        # always run make: its dependency tracking makes this a no-op when
+        # the .so is fresh, and rebuilds after any csrc/ change so a stale
+        # binary is never silently loaded over newer source
+        if not _try_build() and not os.path.exists(_LIB_PATH):
             return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)

@@ -1,0 +1,88 @@
+"""Multi-chip training-step dryrun, runnable in-process or as a child.
+
+One sharded training step (forward + backward + optimizer, ring attention
+when a seq axis exists) on an ``n_devices`` mesh of virtual CPU devices.
+The driver uses this to validate the dp/sp/tp sharding story compiles and
+executes without real multi-chip hardware.
+
+Designed to be robust to process state: ``ensure_devices`` forces the CPU
+platform *before* the first backend initialization; if JAX has already
+initialized on another platform (e.g. the tunneled TPU), callers must run
+:func:`run` in a fresh subprocess instead (``__graft_entry__`` does this).
+"""
+from __future__ import annotations
+
+import os
+
+
+def ensure_devices(n_devices: int) -> None:
+    """Make >= n_devices JAX devices available, or raise.
+
+    Must be called before JAX initializes a backend in this process —
+    afterwards ``jax_platforms`` flips are silent no-ops.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags +
+            f" --xla_force_host_platform_device_count={n_devices}").strip()
+    import jax
+
+    # The dryrun always wants the virtual CPU mesh (one real TPU chip can
+    # never satisfy n_devices). A sitecustomize may have force-set
+    # jax_platforms to the tunneled TPU via config.update — which overrides
+    # JAX_PLATFORMS — so flip it back BEFORE the first jax.devices() call;
+    # after a backend initializes the flip is a silent no-op (hence the
+    # subprocess fallback in __graft_entry__.dryrun_multichip).
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    try:
+        have = len(jax.devices())
+    except RuntimeError:
+        have = 0
+    if have < n_devices:
+        raise RuntimeError(f"need {n_devices} devices, have {have}")
+
+
+def run(n_devices: int) -> float:
+    """One sharded train step on an n-device mesh (dp x sp x tp)."""
+    ensure_devices(n_devices)
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from nnstreamer_tpu.models import transformer as tfm
+    from nnstreamer_tpu.parallel import GPT_RULES
+    from nnstreamer_tpu.parallel.mesh import best_mesh
+    from nnstreamer_tpu.parallel.train import (create_train_state,
+                                               make_train_step, shard_batch)
+
+    mesh = best_mesh(n_devices)
+    dp, sp, tp = (mesh.shape[a] for a in mesh.axis_names)
+    cfg = tfm.GPTConfig(vocab=256, d_model=64, n_heads=4, n_layers=2,
+                        d_ff=128, mesh=mesh,
+                        seq_axis="seq" if sp > 1 else None)
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    optimizer = optax.adamw(1e-3)
+    state = create_train_state(params, optimizer, mesh, GPT_RULES)
+
+    seq = 8 * sp  # divisible by the seq axis for ring attention blocks
+    batch = jnp.zeros((2 * dp, seq + 1), jnp.int32)
+    batch = shard_batch(batch, mesh, P("data", None))
+
+    step = make_train_step(lambda p, b: tfm.loss_fn(p, b, cfg), optimizer)
+    state, loss = step(state, batch)
+    loss.block_until_ready()
+    assert jnp.isfinite(loss), f"non-finite loss {loss}"
+    print(f"dryrun_multichip: mesh dp={dp} sp={sp} tp={tp} "
+          f"loss={float(loss):.4f} ok", flush=True)
+    return float(loss)
+
+
+if __name__ == "__main__":  # python -m nnstreamer_tpu.parallel.dryrun N
+    import sys
+
+    run(int(sys.argv[1]) if len(sys.argv) > 1 else 8)

@@ -24,8 +24,9 @@ class Queue(Element):
     """Thread boundary with a bounded buffer queue.
 
     Backpressure: upstream ``chain`` blocks when the queue is full
-    (matching gst queue defaults). ``leaky=downstream`` drops the incoming
-    buffer instead — used by QoS-style pipelines.
+    (matching gst queue defaults). GStreamer leaky semantics:
+    ``leaky=upstream`` drops the incoming buffer when full;
+    ``leaky=downstream`` evicts the oldest queued buffer to make room.
     """
 
     SINK_TEMPLATES = {"sink": None}
@@ -37,6 +38,16 @@ class Queue(Element):
         self._q: _pyqueue.Queue = _pyqueue.Queue(maxsize=max(1, self.max_size_buffers))
         self._thread: Optional[threading.Thread] = None
         self._running = False
+
+    def set_property(self, key: str, value) -> None:
+        super().set_property(key, value)
+        if key in ("max-size-buffers", "max_size_buffers"):
+            # properties may be applied after __init__ (launch parser);
+            # resize then — but never once the worker owns the queue
+            if self._running:
+                raise RuntimeError(
+                    f"{self.name}: cannot resize a running queue")
+            self._q = _pyqueue.Queue(maxsize=max(1, self.max_size_buffers))
 
     def start(self) -> None:
         super().start()
@@ -64,11 +75,31 @@ class Queue(Element):
         if isinstance(item, Event):
             self._q.put(item)  # events are serialized: never dropped
             return
-        if self.leaky == "downstream" :
+        if self.leaky == "upstream":
+            # GStreamer leaky=upstream: drop the incoming buffer when full
             try:
                 self._q.put_nowait(item)
             except _pyqueue.Full:
-                pass  # drop newest
+                pass
+        elif self.leaky == "downstream":
+            # GStreamer leaky=downstream: evict the oldest queued BUFFER;
+            # events keep their queue position (they are never dropped)
+            while True:
+                try:
+                    self._q.put_nowait(item)
+                    return
+                except _pyqueue.Full:
+                    dropped = False
+                    with self._q.mutex:
+                        for i, old in enumerate(self._q.queue):
+                            if not isinstance(old, Event):
+                                del self._q.queue[i]
+                                dropped = True
+                                break
+                    if not dropped:
+                        # only events queued: block until the worker drains
+                        self._q.put(item)
+                        return
         else:
             self._q.put(item)  # blocking: backpressure
 

@@ -10,9 +10,13 @@ model-config is a python file defining::
 
 or ``zoo://<name>?...`` for a zoo classifier trained with softmax
 cross-entropy. Samples pushed by tensor_trainer accumulate into
-device batches; epochs run on a background thread over the collected
-training set (the streaming-training model of gsttensor_trainer.c:
-fixed num-training-samples per epoch, epochs loops re-use them).
+device batches; epochs run on a background thread that DRAINS the
+queue each epoch (the streaming-training model of gsttensor_trainer.c:
+the src replays the dataset per epoch, e.g. datareposrc epochs=N, and
+the trainer consumes num-training-samples every epoch). If the stream
+ends early the last complete dataset is reused for remaining epochs,
+and once training finishes further pushed samples are discarded so EOS
+can propagate.
 Checkpoints go through orbax (trainers/checkpoint.py); on a mesh the
 train step is the sharded one from parallel/train.py.
 """
@@ -69,6 +73,7 @@ class JaxTrainer(TrainerFramework):
         self._status_lock = threading.Lock()
         self._stop_evt = threading.Event()
         self._done_evt = threading.Event()
+        self._eos_evt = threading.Event()
         self.params = None
 
     # -- lifecycle --------------------------------------------------------
@@ -98,6 +103,7 @@ class JaxTrainer(TrainerFramework):
     def start(self) -> None:
         self._stop_evt.clear()
         self._done_evt.clear()
+        self._eos_evt.clear()
         self._thread = threading.Thread(target=self._train_loop,
                                         name="jax-trainer", daemon=True)
         self._thread.start()
@@ -119,12 +125,20 @@ class JaxTrainer(TrainerFramework):
 
     # -- data -------------------------------------------------------------
     def push_data(self, tensors: Sequence[Any]) -> None:
-        while not self._stop_evt.is_set():
+        # discard once training has finished so upstream never blocks on a
+        # full queue after the last epoch (EOS must still propagate)
+        while not self._stop_evt.is_set() and not self._done_evt.is_set():
             try:
                 self._queue.put(list(tensors), timeout=0.5)
                 return
             except _pyqueue.Full:
                 continue
+
+    def end_of_data(self) -> None:
+        """Upstream EOS: no more samples will arrive. The training loop
+        stops waiting on the queue and reuses the last complete dataset
+        for any remaining epochs."""
+        self._eos_evt.set()
 
     def get_status(self) -> TrainerStatus:
         with self._status_lock:
@@ -135,12 +149,13 @@ class JaxTrainer(TrainerFramework):
 
     # -- training loop ----------------------------------------------------
     def _collect(self, n: int) -> Optional[List[List[np.ndarray]]]:
-        samples = []
+        samples: List[List[np.ndarray]] = []
         while len(samples) < n and not self._stop_evt.is_set():
             try:
-                samples.append(self._queue.get(timeout=0.5))
+                samples.append(self._queue.get(timeout=0.1))
             except _pyqueue.Empty:
-                continue
+                if self._eos_evt.is_set() and self._queue.empty():
+                    break  # stream ended mid-epoch; caller reuses last set
         return samples if len(samples) == n else None
 
     def _train_loop(self) -> None:
@@ -173,14 +188,25 @@ class JaxTrainer(TrainerFramework):
             return self._loss_fn(params, inputs, labels)
 
         try:
-            train = self._collect(p.num_training_samples)
-            if train is None:
-                return
-            val = None
-            if p.num_validation_samples:
-                val = self._collect(p.num_validation_samples)
+            train: Optional[List[List[np.ndarray]]] = None
+            val: Optional[List[List[np.ndarray]]] = None
             for epoch in range(1, p.epochs + 1):
                 if self._stop_evt.is_set():
+                    return
+                # drain this epoch's samples from the stream; on a short
+                # stream (src stopped replaying) reuse the previous epoch's
+                t = self._collect(p.num_training_samples)
+                if self._stop_evt.is_set():
+                    return  # stop requested mid-collection: no extra step
+                if t is not None:
+                    train = t
+                    if p.num_validation_samples:
+                        v = self._collect(p.num_validation_samples)
+                        if v is not None:
+                            val = v
+                if train is None:
+                    logger.warning("jax trainer: stream ended before a full "
+                                   "training set arrived; aborting")
                     return
                 inputs, labels = batch_of(train)
                 self.params, opt_state, loss, acc = step(
