@@ -15,7 +15,7 @@ from typing import List, Optional
 
 import numpy as np
 
-from ..pipeline.element import SinkElement, SrcElement
+from ..pipeline.element import SinkElement, SrcElement, TransformElement
 from ..pipeline.registry import register_element
 from ..tensors.buffer import Buffer, Chunk
 from ..tensors.caps import Caps
@@ -186,6 +186,135 @@ class MultiFileSrc(SrcElement):
             data = f.read()
         self._idx += 1
         return Buffer([Chunk(np.frombuffer(data, np.uint8))])
+
+
+@register_element("pngdec")
+class PngDec(TransformElement):
+    """Decode PNG (or JPEG — ``jpegdec`` is an alias) buffers into
+    video/x-raw RGB frames (≙ gst pngdec in the reference's golden
+    pipelines, tests/nnstreamer_filter_tensorflow2_lite/runTest.sh:77).
+    Output caps are fixed from the first decoded frame."""
+
+    SINK_TEMPLATES = {"sink": None}
+    SRC_TEMPLATES = {"src": "video/x-raw"}
+
+    def on_sink_caps(self, pad, caps) -> None:
+        pass  # frame size unknown until the first buffer decodes
+
+    def transform(self, buf: Buffer) -> Optional[Buffer]:
+        import io
+
+        from PIL import Image
+        img = Image.open(io.BytesIO(buf.chunks[0].host().tobytes()))
+        frame = np.asarray(img.convert("RGB"))
+        if self.srcpad.caps is None:
+            h, w = frame.shape[:2]
+            self.set_src_caps(Caps(
+                f"video/x-raw,format=RGB,width={w},height={h},"
+                "framerate=0/1"))
+        return Buffer([Chunk(frame)], pts=buf.pts, duration=buf.duration)
+
+
+register_element("jpegdec")(PngDec)
+
+
+@register_element("videoscale")
+class VideoScale(TransformElement):
+    """Scale video frames to ``width`` x ``height`` (bilinear). The gst
+    videoscale negotiates its target size with a downstream capsfilter;
+    this runtime's negotiation is push-based, so the target is given as
+    properties instead."""
+
+    SINK_TEMPLATES = {"sink": "video/x-raw"}
+    SRC_TEMPLATES = {"src": "video/x-raw"}
+    PROPS = {"width": 0, "height": 0}
+
+    def on_sink_caps(self, pad, caps) -> None:
+        (h, w, _), fmt = video_frame_shape(caps)
+        out_w = self.width or w
+        out_h = self.height or h
+        s = caps.structures[0]
+        rate = s.fields.get("framerate", "0/1")
+        self.set_src_caps(Caps(
+            f"video/x-raw,format={fmt},width={out_w},height={out_h},"
+            f"framerate={rate}"))
+
+    def transform(self, buf: Buffer) -> Optional[Buffer]:
+        frame = buf.chunks[0].host()
+        (h, w, _), _ = video_frame_shape(self.srcpad.caps)
+        if frame.shape[0] == h and frame.shape[1] == w:
+            return buf
+        from PIL import Image
+        gray = frame.ndim == 3 and frame.shape[-1] == 1
+        img = Image.fromarray(frame[..., 0] if gray else frame)
+        out = np.asarray(img.resize((w, h), Image.BILINEAR))
+        if gray:
+            out = out[..., None]
+        return Buffer([Chunk(out)], pts=buf.pts, duration=buf.duration)
+
+
+@register_element("videoconvert")
+class VideoConvert(TransformElement):
+    """Colorspace conversion between the supported raw formats (RGB/BGR/
+    RGBA/BGRx/GRAY8). Target format via the ``format`` property (gst
+    negotiates with a capsfilter instead)."""
+
+    SINK_TEMPLATES = {"sink": "video/x-raw"}
+    SRC_TEMPLATES = {"src": "video/x-raw"}
+    PROPS = {"format": ""}
+
+    def on_sink_caps(self, pad, caps) -> None:
+        (h, w, _), fmt = video_frame_shape(caps)
+        out_fmt = self.format or fmt
+        s = caps.structures[0]
+        rate = s.fields.get("framerate", "0/1")
+        self.set_src_caps(Caps(
+            f"video/x-raw,format={out_fmt},width={w},height={h},"
+            f"framerate={rate}"))
+
+    def transform(self, buf: Buffer) -> Optional[Buffer]:
+        _, in_fmt = video_frame_shape(self.sinkpad.caps)
+        _, out_fmt = video_frame_shape(self.srcpad.caps)
+        if in_fmt == out_fmt:
+            return buf
+        frame = buf.chunks[0].host()
+        rgb = self._to_rgb(frame, in_fmt)
+        out = self._from_rgb(rgb, out_fmt)
+        return Buffer([Chunk(out)], pts=buf.pts, duration=buf.duration)
+
+    @staticmethod
+    def _to_rgb(frame: np.ndarray, fmt: str) -> np.ndarray:
+        if fmt == "RGB":
+            return frame
+        if fmt == "BGR":
+            return frame[..., ::-1]
+        if fmt == "RGBA":
+            return frame[..., :3]
+        if fmt == "BGRx":
+            return frame[..., 2::-1]
+        if fmt == "GRAY8":
+            return np.repeat(frame, 3, axis=-1) if frame.shape[-1] == 1 \
+                else np.repeat(frame[..., None], 3, axis=-1)
+        raise ValueError(f"unsupported video format {fmt!r}")
+
+    @staticmethod
+    def _from_rgb(rgb: np.ndarray, fmt: str) -> np.ndarray:
+        if fmt == "RGB":
+            return np.ascontiguousarray(rgb)
+        if fmt == "BGR":
+            return np.ascontiguousarray(rgb[..., ::-1])
+        if fmt == "RGBA":
+            return np.concatenate(
+                [rgb, np.full(rgb.shape[:2] + (1,), 255, np.uint8)], -1)
+        if fmt == "BGRx":
+            return np.concatenate(
+                [rgb[..., ::-1],
+                 np.full(rgb.shape[:2] + (1,), 255, np.uint8)], -1)
+        if fmt == "GRAY8":
+            return np.round(
+                rgb @ np.array([0.299, 0.587, 0.114])).astype(
+                    np.uint8)[..., None]
+        raise ValueError(f"unsupported video format {fmt!r}")
 
 
 @register_element("filesink")

@@ -1,5 +1,6 @@
 """Filter-backend subplugins (L5) and their registry (L2)."""
-from . import custom, custom_c, jax_backend, llm  # noqa: F401  (register built-in backends)
+from . import (custom, custom_c, jax_backend, llm,  # noqa: F401
+               onnx_backend, tflite_backend)  # (register built-in backends)
 from .base import (Accelerator, FilterEvent, FilterFramework,
                    FilterProperties)
 from .custom import register_custom_easy, unregister_custom_easy

@@ -1,0 +1,26 @@
+"""onnxruntime interop backend: .onnx models on the XLA path.
+
+≙ ext/nnstreamer/tensor_filter/tensor_filter_onnxruntime.cc (478 LoC
+around the ORT C++ session). The model is imported once
+(interop/onnx.py) into a jittable function compiled by XLA — same
+convergence story as the tensorflow-lite backend.
+"""
+from __future__ import annotations
+
+from .interop_base import ImportedModelFilter
+from .registry import register_alias, register_filter
+
+
+def _load(path: str):
+    from ..interop import onnx
+    return onnx.load(path)
+
+
+@register_filter
+class ONNXFilter(ImportedModelFilter):
+    NAME = "onnxruntime"
+    EXTENSIONS = (".onnx",)
+    _load = staticmethod(_load)
+
+
+register_alias("onnx", "onnxruntime")

@@ -26,8 +26,8 @@ _LOCK = threading.Lock()
 
 # Detection priority when multiple backends claim an extension
 # (≙ filter-framework-priority in nnstreamer.ini.in:12-19).
-_PRIORITY = ["jax", "flax", "custom-easy", "python3", "tflite-interop",
-             "torch-interop", "onnx-interop"]
+_PRIORITY = ["jax", "flax", "custom-easy", "python3", "tensorflow-lite",
+             "onnxruntime"]
 
 
 def register_filter(cls: Type[FilterFramework]) -> Type[FilterFramework]:

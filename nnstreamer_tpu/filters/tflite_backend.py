@@ -1,0 +1,32 @@
+"""tensorflow-lite interop backend: .tflite models on the XLA path.
+
+≙ ext/nnstreamer/tensor_filter/tensor_filter_tensorflow_lite.cc (the
+reference's benchmark-baseline backend, 1825 LoC around the TFLite
+interpreter + XNNPACK/GPU/NNAPI delegates). Here the model is imported
+once (interop/tflite.py) into a jittable function, so "delegate" is
+simply XLA on the chosen device — the same engine as the jax backend,
+which is the point: interop formats converge on the MXU path.
+
+Framework names: ``tensorflow-lite`` (canonical), aliases
+``tensorflow2-lite`` / ``tflite`` match the reference's property values.
+"""
+from __future__ import annotations
+
+from .interop_base import ImportedModelFilter
+from .registry import register_alias, register_filter
+
+
+def _load(path: str):
+    from ..interop import tflite
+    return tflite.load(path)
+
+
+@register_filter
+class TFLiteFilter(ImportedModelFilter):
+    NAME = "tensorflow-lite"
+    EXTENSIONS = (".tflite",)
+    _load = staticmethod(_load)
+
+
+register_alias("tensorflow2-lite", "tensorflow-lite")
+register_alias("tflite", "tensorflow-lite")

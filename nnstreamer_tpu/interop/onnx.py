@@ -1,0 +1,485 @@
+"""ONNX model importer: .onnx protobuf -> one jittable JAX function.
+
+≙ ext/nnstreamer/tensor_filter/tensor_filter_onnxruntime.cc (the
+reference wraps the onnxruntime C++ session). Here the graph is parsed
+with the schema-less protobuf reader (interop/protowire.py) and lowered
+op-by-op to JAX, so ONNX models run on the same XLA path as everything
+else. Supports the float op set plus the QOperator quantized ops
+(QLinearConv/QLinearAdd/QLinearGlobalAveragePool/QLinearMatMul) in float
+simulation: weights dequantize at import, activations stay float and are
+clamped to each quantized tensor's representable range (see
+interop/tflite.py for the same technique).
+
+Layout stays NCHW as ONNX declares it — XLA's layout assignment handles
+the TPU-side physical layout.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..tensors.info import TensorInfo, TensorsInfo
+from ..tensors.types import TensorType
+from . import protowire as pw
+
+# TensorProto.DataType
+_ELEM_NP = {1: np.float32, 2: np.uint8, 3: np.int8, 4: np.uint16,
+            5: np.int16, 6: np.int32, 7: np.int64, 9: np.bool_,
+            10: np.float16, 11: np.float64, 12: np.uint32, 13: np.uint64}
+
+
+@dataclasses.dataclass
+class _Node:
+    op: str
+    inputs: List[str]
+    outputs: List[str]
+    attrs: Dict[str, Any]
+
+
+@dataclasses.dataclass
+class ONNXModel:
+    fn: Callable
+    input_info: TensorsInfo
+    output_info: TensorsInfo
+    path: str
+
+
+# -- protobuf walking ------------------------------------------------------
+
+def _parse_tensor_proto(data: bytes) -> Tuple[str, np.ndarray]:
+    """TensorProto -> (name, ndarray)."""
+    msg = pw.decode(data)
+    dims = [pw.as_sint(d) for d in msg.get(1, [])]
+    dtype = _ELEM_NP[msg.get(2, [1])[0]]
+    name = msg.get(8, [b""])[0].decode()
+    if 9 in msg:  # raw_data
+        arr = np.frombuffer(msg[9][0], dtype=dtype)
+    elif 4 in msg and dtype == np.float32:  # packed float_data
+        raw = msg[4][0] if isinstance(msg[4][0], bytes) else None
+        if raw is not None:
+            arr = np.frombuffer(raw, np.float32)
+        else:
+            arr = np.array([pw.as_f32(v) for v in msg[4]], np.float32)
+    elif 7 in msg:  # int64_data
+        raw = msg[7][0] if isinstance(msg[7][0], bytes) else None
+        vals = pw.packed_varints(raw) if raw is not None else msg[7]
+        arr = np.array([pw.as_sint(v) for v in vals], np.int64)
+    elif 5 in msg:  # int32_data (also holds u8/i8 payloads)
+        raw = msg[5][0] if isinstance(msg[5][0], bytes) else None
+        vals = pw.packed_varints(raw) if raw is not None else msg[5]
+        arr = np.array([pw.as_sint(v) for v in vals]).astype(dtype)
+    else:
+        arr = np.zeros(0, dtype)
+    return name, arr.reshape(dims) if dims else arr.reshape(())
+
+
+def _parse_attr(data: bytes) -> Tuple[str, Any]:
+    msg = pw.decode(data)
+    name = msg[1][0].decode()
+    atype = msg.get(20, [0])[0]
+    if atype == 1:   # FLOAT
+        return name, pw.as_f32(msg[2][0])
+    if atype == 2:   # INT
+        return name, pw.as_sint(msg[3][0])
+    if atype == 3:   # STRING
+        return name, msg[4][0].decode()
+    if atype == 4:   # TENSOR
+        return name, _parse_tensor_proto(msg[5][0])[1]
+    if atype == 6:   # FLOATS
+        vals = msg.get(7, [])
+        if vals and isinstance(vals[0], bytes):
+            return name, np.frombuffer(vals[0], "<f4").tolist()
+        return name, [pw.as_f32(v) for v in vals]
+    if atype == 7:   # INTS
+        vals = msg.get(8, [])
+        if vals and isinstance(vals[0], bytes):
+            return name, [pw.as_sint(v) for v in pw.packed_varints(vals[0])]
+        return name, [pw.as_sint(v) for v in vals]
+    return name, None
+
+
+def _parse_value_info(data: bytes) -> Tuple[str, Any, Tuple[int, ...]]:
+    vi = pw.decode(data)
+    name = vi[1][0].decode()
+    dtype, shape = np.float32, ()
+    if 2 in vi:
+        t = pw.decode(vi[2][0])
+        if 1 in t:  # tensor_type
+            tt = pw.decode(t[1][0])
+            dtype = _ELEM_NP.get(tt.get(1, [1])[0], np.float32)
+            dims = []
+            if 2 in tt:
+                for db in pw.decode(tt[2][0]).get(1, []):
+                    d = pw.decode(db)
+                    dims.append(int(pw.as_sint(d[1][0])) if 1 in d else 1)
+            shape = tuple(dims)
+    return name, dtype, shape
+
+
+def parse(path: str):
+    with open(path, "rb") as f:
+        model = pw.decode(f.read())
+    graph = pw.decode(model[7][0])
+    inits: Dict[str, np.ndarray] = {}
+    for tb in graph.get(5, []):
+        name, arr = _parse_tensor_proto(tb)
+        inits[name] = arr
+    nodes: List[_Node] = []
+    for nb in graph.get(1, []):
+        n = pw.decode(nb)
+        nodes.append(_Node(
+            op=n[4][0].decode(),
+            inputs=[v.decode() for v in n.get(1, [])],
+            outputs=[v.decode() for v in n.get(2, [])],
+            attrs=dict(_parse_attr(ab) for ab in n.get(5, []))))
+    g_in = [_parse_value_info(vb) for vb in graph.get(11, [])
+            if pw.decode(vb)[1][0].decode() not in inits]
+    g_out = [_parse_value_info(vb) for vb in graph.get(12, [])]
+    return nodes, inits, g_in, g_out
+
+
+# -- lowering --------------------------------------------------------------
+
+def _conv(lax, jnp, x, w, b, attrs, group=1):
+    strides = tuple(attrs.get("strides", [1, 1]))
+    dil = tuple(attrs.get("dilations", [1, 1]))
+    pads = attrs.get("pads")
+    if attrs.get("auto_pad", "NOTSET") in ("SAME_UPPER", "SAME_LOWER"):
+        padding = "SAME"
+    elif pads:
+        n = len(pads) // 2
+        padding = [(int(pads[i]), int(pads[i + n])) for i in range(n)]
+    else:
+        padding = "VALID"
+    y = lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=padding, rhs_dilation=dil,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=group,
+        preferred_element_type=jnp.float32)
+    if b is not None:
+        y = y + b.reshape(1, -1, 1, 1)
+    return y
+
+
+def _dequant_w(w: np.ndarray, scale: np.ndarray,
+               zp: np.ndarray) -> np.ndarray:
+    """Weights to float; per-output-channel scale broadcasts on axis 0."""
+    s = np.asarray(scale, np.float64)
+    z = np.asarray(zp, np.float64)
+    if s.ndim == 0 or s.size == 1:
+        return ((w.astype(np.float64) - z.reshape(()) if z.size == 1
+                 else w.astype(np.float64) - z) * s.reshape(())) \
+            .astype(np.float32)
+    bshape = [1] * w.ndim
+    bshape[0] = s.size
+    return ((w.astype(np.float64) - z.reshape(bshape))
+            * s.reshape(bshape)).astype(np.float32)
+
+
+def _qrange_clip(jnp, y, scale, zp, dtype):
+    info = np.iinfo(dtype)
+    s = float(np.asarray(scale).reshape(-1)[0])
+    z = float(np.asarray(zp).reshape(-1)[0])
+    return jnp.clip(y, (info.min - z) * s, (info.max - z) * s)
+
+
+def _lower(nodes: List[_Node], inits: Dict[str, np.ndarray],
+           g_in, g_out) -> Callable:
+    import jax.numpy as jnp
+    from jax import lax
+
+    consts: Dict[str, Any] = dict(inits)
+
+    # quantized graph boundaries: a u8/i8 graph input is consumed by a
+    # DequantizeLinear (whose scale/zp dequantize it here at the boundary);
+    # a u8/i8 graph output is produced by a QuantizeLinear (requantize at
+    # the boundary so the wire dtype matches the declared signature)
+    in_q: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+    out_q: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+    quant_names = {name for name, dtype, _ in g_in + g_out
+                   if np.dtype(dtype) in (np.dtype(np.uint8),
+                                          np.dtype(np.int8))}
+    for node in nodes:
+        if node.op == "DequantizeLinear" and node.inputs[0] in quant_names:
+            zp = inits.get(node.inputs[2]) if len(node.inputs) > 2 \
+                else np.zeros(1, np.int64)
+            in_q[node.inputs[0]] = (inits[node.inputs[1]], zp)
+        if node.op == "QuantizeLinear" and node.outputs[0] in quant_names:
+            zp = inits.get(node.inputs[2]) if len(node.inputs) > 2 \
+                else np.zeros(1, np.uint8)
+            out_q[node.outputs[0]] = (inits[node.inputs[1]], zp)
+
+    def fn(*args):
+        env: Dict[str, Any] = {}
+        for (name, dtype, shape), x in zip(g_in, args):
+            if tuple(x.shape) != shape and int(np.prod(shape)) == x.size:
+                x = x.reshape(shape)
+            if name in in_q:
+                scale, zp = in_q[name]
+                x = (x.astype(jnp.float32)
+                     - float(np.asarray(zp).reshape(-1)[0])) \
+                    * float(np.asarray(scale).reshape(-1)[0])
+            env[name] = x
+
+        def val(name: str):
+            if name in env:
+                return env[name]
+            if name in consts:
+                return consts[name]
+            raise KeyError(f"onnx tensor {name!r} not materialized")
+
+        def npval(name: str) -> np.ndarray:
+            v = val(name)
+            if isinstance(v, np.ndarray):
+                return v
+            raise NotImplementedError(
+                f"onnx: need compile-time constant {name!r}")
+
+        for node in nodes:
+            outs = _eval_node(node, val, npval, jnp, lax)
+            for oname, oval in zip(node.outputs, outs):
+                env[oname] = oval
+        results = []
+        for name, dtype, _ in g_out:
+            y = jnp.asarray(val(name))
+            if name in out_q:
+                scale, zp = out_q[name]
+                info = np.iinfo(dtype)
+                q = jnp.round(y / float(np.asarray(scale).reshape(-1)[0])) \
+                    + float(np.asarray(zp).reshape(-1)[0])
+                y = jnp.clip(q, info.min, info.max).astype(dtype)
+            results.append(y)
+        return results
+
+    return fn
+
+
+def _eval_node(node: _Node, val, npval, jnp, lax) -> List[Any]:
+    op, a = node.op, node.attrs
+    i = node.inputs
+
+    def qval(x_idx: int, scale_idx: int, zp_idx: int):
+        """A QLinear op's activation operand: runtime values are already
+        float (simulation), but quantized CONSTANTS (e.g. a bias fed as a
+        u8 initializer) must dequantize with their scale/zp inputs."""
+        v = val(i[x_idx])
+        if isinstance(v, np.ndarray) and v.dtype in (np.uint8, np.int8):
+            return _dequant_w(v, npval(i[scale_idx]), npval(i[zp_idx]))
+        return v
+
+    if op == "Conv":
+        w = np.asarray(npval(i[1]), np.float32)
+        b = np.asarray(npval(i[2]), np.float32) if len(i) > 2 else None
+        return [_conv(lax, jnp, val(i[0]), jnp.asarray(w), b, a,
+                      int(a.get("group", 1)))]
+
+    if op == "QLinearConv":
+        x = val(i[0])
+        w = _dequant_w(npval(i[3]), npval(i[4]), npval(i[5]))
+        b = None
+        if len(i) > 8:
+            # int32 bias, scale = x_scale * w_scale (per channel)
+            bs = np.asarray(npval(i[1]), np.float64) * \
+                np.asarray(npval(i[4]), np.float64).reshape(-1)
+            b = (npval(i[8]).astype(np.float64) * bs).astype(np.float32)
+        y = _conv(lax, jnp, x, jnp.asarray(w), b, a, int(a.get("group", 1)))
+        return [_qrange_clip(jnp, y, npval(i[6]), npval(i[7]),
+                             npval(i[7]).dtype)]
+
+    if op in ("QuantizeLinear", "DequantizeLinear"):
+        x = val(i[0])
+        if isinstance(x, np.ndarray) and x.dtype in (np.uint8, np.int8):
+            # dequantizing a quantized constant
+            return [_dequant_w(x, npval(i[1]),
+                               npval(i[2]) if len(i) > 2 else
+                               np.zeros(1, np.int64))]
+        if op == "QuantizeLinear":
+            zp = npval(i[2]) if len(i) > 2 else np.zeros(1, np.uint8)
+            return [_qrange_clip(jnp, x, npval(i[1]), zp, zp.dtype)]
+        return [x]  # float simulation: already float
+
+    if op == "QLinearAdd":  # com.microsoft
+        y = qval(0, 1, 2) + qval(3, 4, 5)
+        return [_qrange_clip(jnp, y, npval(i[6]), npval(i[7]),
+                             npval(i[7]).dtype)]
+
+    if op == "QLinearMul":
+        y = qval(0, 1, 2) * qval(3, 4, 5)
+        return [_qrange_clip(jnp, y, npval(i[6]), npval(i[7]),
+                             npval(i[7]).dtype)]
+
+    if op == "QLinearGlobalAveragePool":
+        x = qval(0, 1, 2)
+        y = jnp.mean(x, axis=(2, 3), keepdims=True)
+        return [_qrange_clip(jnp, y, npval(i[3]), npval(i[4]),
+                             npval(i[4]).dtype)]
+
+    if op == "QLinearMatMul":
+        x = val(i[0])
+        w = _dequant_w(npval(i[3]), npval(i[4]), npval(i[5]))
+        y = jnp.matmul(x, jnp.asarray(w))
+        return [_qrange_clip(jnp, y, npval(i[6]), npval(i[7]),
+                             npval(i[7]).dtype)]
+
+    if op == "Add":
+        return [val(i[0]) + val(i[1])]
+    if op == "Sub":
+        return [val(i[0]) - val(i[1])]
+    if op == "Mul":
+        return [val(i[0]) * val(i[1])]
+    if op == "Div":
+        return [val(i[0]) / val(i[1])]
+    if op == "Relu":
+        return [jnp.maximum(val(i[0]), 0.0)]
+    if op == "Sigmoid":
+        return [1.0 / (1.0 + jnp.exp(-val(i[0])))]
+    if op == "Tanh":
+        return [jnp.tanh(val(i[0]))]
+    if op == "Clip":
+        lo = float(npval(i[1])) if len(i) > 1 and i[1] else \
+            a.get("min", -np.inf)
+        hi = float(npval(i[2])) if len(i) > 2 and i[2] else \
+            a.get("max", np.inf)
+        return [jnp.clip(val(i[0]), lo, hi)]
+    if op == "LeakyRelu":
+        alpha = a.get("alpha", 0.01)
+        x = val(i[0])
+        return [jnp.where(x >= 0, x, alpha * x)]
+    if op == "HardSwish":
+        x = val(i[0])
+        return [x * jnp.clip(x / 6.0 + 0.5, 0.0, 1.0)]
+    if op == "HardSigmoid":
+        return [jnp.clip(val(i[0]) * a.get("alpha", 0.2)
+                         + a.get("beta", 0.5), 0.0, 1.0)]
+    if op == "Erf":
+        from jax.scipy.special import erf
+        return [erf(val(i[0]))]
+    if op == "Exp":
+        return [jnp.exp(val(i[0]))]
+    if op == "Sqrt":
+        return [jnp.sqrt(val(i[0]))]
+    if op == "Pow":
+        return [val(i[0]) ** val(i[1])]
+
+    if op == "GlobalAveragePool":
+        return [jnp.mean(val(i[0]), axis=(2, 3), keepdims=True)]
+
+    if op in ("MaxPool", "AveragePool"):
+        x = val(i[0])
+        k = tuple(a["kernel_shape"])
+        strides = tuple(a.get("strides", [1] * len(k)))
+        pads = a.get("pads")
+        if pads and any(pads):
+            n = len(pads) // 2
+            pad = [(0, 0), (0, 0)] + \
+                [(int(pads[d]), int(pads[d + n])) for d in range(n)]
+        else:
+            pad = "VALID"
+        window = (1, 1) + k
+        stride4 = (1, 1) + strides
+        if op == "MaxPool":
+            return [lax.reduce_window(x, -jnp.inf, lax.max, window,
+                                      stride4, pad)]
+        s = lax.reduce_window(x, 0.0, lax.add, window, stride4, pad)
+        n_el = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, window,
+                                 stride4, pad)
+        return [s / n_el]
+
+    if op == "Gemm":
+        x, w = val(i[0]), np.asarray(npval(i[1]), np.float32)
+        if a.get("transB", 0):
+            w = w.T
+        y = (x if not a.get("transA", 0) else x.T) @ jnp.asarray(w) \
+            * a.get("alpha", 1.0)
+        if len(i) > 2:
+            y = y + np.asarray(npval(i[2]), np.float32) * a.get("beta", 1.0)
+        return [y]
+
+    if op == "MatMul":
+        return [jnp.matmul(val(i[0]), val(i[1]))]
+
+    if op == "Reshape":
+        shape = [int(d) for d in npval(i[1])]
+        return [val(i[0]).reshape(shape)]
+    if op == "Flatten":
+        x = val(i[0])
+        axis = a.get("axis", 1)
+        lead = int(np.prod(x.shape[:axis])) if axis else 1
+        return [x.reshape(lead, -1)]
+    if op == "Transpose":
+        return [jnp.transpose(val(i[0]), a.get("perm"))]
+    if op == "Concat":
+        return [jnp.concatenate([val(n) for n in i], axis=a["axis"])]
+    if op == "Squeeze":
+        axes = a.get("axes") or ([int(d) for d in npval(i[1])]
+                                 if len(i) > 1 else None)
+        return [jnp.squeeze(val(i[0]),
+                            tuple(axes) if axes is not None else None)]
+    if op == "Unsqueeze":
+        axes = a.get("axes") or [int(d) for d in npval(i[1])]
+        x = val(i[0])
+        for ax in sorted(axes):
+            x = jnp.expand_dims(x, ax)
+        return [x]
+    if op == "Softmax":
+        x = val(i[0])
+        ax = a.get("axis", -1)
+        m = x.max(axis=ax, keepdims=True)
+        e = jnp.exp(x - m)
+        return [e / e.sum(axis=ax, keepdims=True)]
+    if op == "ReduceMean":
+        axes = a.get("axes") or ([int(d) for d in npval(i[1])]
+                                 if len(i) > 1 else None)
+        return [jnp.mean(val(i[0]),
+                         axis=tuple(axes) if axes else None,
+                         keepdims=bool(a.get("keepdims", 1)))]
+    if op == "Shape":
+        return [np.asarray(val(i[0]).shape, np.int64)]
+    if op == "Gather":
+        return [jnp.take(val(i[0]), val(i[1]),
+                         axis=a.get("axis", 0))]
+    if op == "Constant":
+        return [a.get("value")]
+    if op == "Identity":
+        return [val(i[0])]
+    if op == "Cast":
+        return [val(i[0]).astype(_ELEM_NP[a["to"]])]
+    if op == "Pad":
+        x = val(i[0])
+        pads = a.get("pads") or [int(p) for p in npval(i[1])]
+        n = len(pads) // 2
+        return [jnp.pad(x, [(pads[d], pads[d + n]) for d in range(n)])]
+    if op == "BatchNormalization":
+        x = val(i[0])
+        scale = np.asarray(npval(i[1]), np.float32)
+        bias = np.asarray(npval(i[2]), np.float32)
+        mean = np.asarray(npval(i[3]), np.float32)
+        var = np.asarray(npval(i[4]), np.float32)
+        eps = a.get("epsilon", 1e-5)
+        shape = [1, -1] + [1] * (x.ndim - 2)
+        return [(x - mean.reshape(shape))
+                / np.sqrt(var + eps).reshape(shape)
+                * scale.reshape(shape) + bias.reshape(shape)]
+
+    raise NotImplementedError(f"onnx op {op!r} not supported")
+
+
+# -- public API ------------------------------------------------------------
+
+def _info(entries) -> TensorsInfo:
+    infos = TensorsInfo()
+    for name, dtype, shape in entries:
+        infos.append(TensorInfo(
+            name=name or None,
+            type=TensorType.from_dtype(np.dtype(dtype)),
+            shape=tuple(int(d) for d in shape)))
+    return infos
+
+
+def load(path: str) -> ONNXModel:
+    nodes, inits, g_in, g_out = parse(path)
+    fn = _lower(nodes, inits, g_in, g_out)
+    return ONNXModel(fn=fn, input_info=_info(g_in),
+                     output_info=_info(g_out), path=path)

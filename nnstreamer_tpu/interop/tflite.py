@@ -1,0 +1,603 @@
+"""TFLite model importer: .tflite flatbuffer -> one jittable JAX function.
+
+The reference runs .tflite models through the TensorFlow Lite interpreter
+(ext/nnstreamer/tensor_filter/tensor_filter_tensorflow_lite.cc:1-1825,
+delegates XNNPACK/GPU/NNAPI). The TPU-native equivalent is an importer:
+parse the flatbuffer once at open, dequantize constants, and lower the op
+graph to a pure JAX function that XLA compiles for the MXU — the model
+becomes a first-class jit program instead of an interpreter call.
+
+Quantized models run in float simulation: uint8/int8 weights dequantize at
+import ((q - zero_point) * scale, per-tensor or per-axis), activations stay
+float end-to-end, and graph inputs/outputs (de)quantize at the boundary so
+the wire dtypes match the model's declared signature. Classification
+argmax is invariant under the final affine requantization, so golden-label
+parity holds (tests mirror
+tests/nnstreamer_filter_tensorflow2_lite/runTest.sh:69-80).
+
+Static shapes only — consistent with both TFLite's static tensor shapes
+and XLA's compilation model.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..tensors.info import TensorInfo, TensorsInfo
+from ..tensors.types import TensorType
+from .flatbuf import FlatBuf
+
+# -- schema enums (tensorflow/lite/schema/schema.fbs) ----------------------
+
+_TENSOR_NP = {0: np.float32, 1: np.float16, 2: np.int32, 3: np.uint8,
+              4: np.int64, 6: np.bool_, 7: np.int16, 9: np.int8,
+              10: np.float64}
+
+# BuiltinOperator values used below
+ADD, AVERAGE_POOL_2D, CONCATENATION, CONV_2D, DEPTHWISE_CONV_2D = 0, 1, 2, 3, 4
+DEQUANTIZE, FULLY_CONNECTED, LOGISTIC, MAX_POOL_2D, MUL = 6, 9, 14, 17, 18
+RELU, RELU6, RESHAPE, RESIZE_BILINEAR, SOFTMAX, TANH = 19, 21, 22, 23, 25, 28
+PAD, TRANSPOSE, MEAN, SUB, DIV, SQUEEZE, STRIDED_SLICE = 34, 39, 40, 41, 42, 43, 45
+EXP, LOG_SOFTMAX, CAST, PRELU, MAXIMUM, ARG_MAX, MINIMUM = 47, 50, 53, 54, 55, 56, 57
+SLICE, TRANSPOSE_CONV, EXPAND_DIMS, SUM, SHAPE, POW = 65, 67, 70, 74, 77, 78
+PACK, LEAKY_RELU, SQUARED_DIFFERENCE, ABS = 83, 98, 99, 101
+RESIZE_NEAREST_NEIGHBOR = 97
+QUANTIZE, HARD_SWISH = 114, 117
+BATCH_MATMUL = 126
+BROADCAST_TO, BROADCAST_ARGS = 130, 145
+
+_OP_NAMES = {v: k for k, v in list(globals().items())
+             if isinstance(v, int) and k.isupper()}
+
+
+@dataclasses.dataclass
+class _Tensor:
+    index: int
+    name: str
+    shape: Tuple[int, ...]
+    dtype: Any                       # numpy dtype class
+    scale: Optional[np.ndarray]      # quant scale(s) or None
+    zero_point: Optional[np.ndarray]
+    quant_axis: int
+    const: Optional[np.ndarray]      # raw constant data (un-dequantized)
+
+    @property
+    def quantized(self) -> bool:
+        return self.scale is not None and self.scale.size > 0 and \
+            self.dtype in (np.uint8, np.int8, np.int32, np.int16)
+
+
+@dataclasses.dataclass
+class _Op:
+    code: int
+    inputs: List[int]
+    outputs: List[int]
+    options: Optional[int]           # table position in the flatbuffer
+    fb: FlatBuf
+
+
+@dataclasses.dataclass
+class TFLiteModel:
+    """Parsed model: jittable ``fn(*inputs) -> list[outputs]`` plus the
+    tensor signature in framework terms."""
+
+    fn: Callable
+    input_info: TensorsInfo
+    output_info: TensorsInfo
+    path: str
+
+
+# -- parsing ---------------------------------------------------------------
+
+def _parse_tensor(fb: FlatBuf, pos: int, index: int,
+                  buffers: List[Optional[np.ndarray]]) -> _Tensor:
+    shape = fb.field_np(pos, 0, np.int32)
+    shape = () if shape is None else tuple(int(d) for d in shape)
+    ttype = fb.field_scalar(pos, 1, "u8")
+    if ttype not in _TENSOR_NP:
+        raise NotImplementedError(f"tflite tensor type {ttype} unsupported")
+    dtype = _TENSOR_NP[ttype]
+    buf_idx = fb.field_scalar(pos, 2, "u32")
+    name = fb.field_string(pos, 3)
+    scale = zero = None
+    qaxis = 0
+    q = fb.field_table(pos, 4)
+    if q is not None:
+        scale = fb.field_np(q, 2, np.float32)
+        zero = fb.field_np(q, 3, np.int64)
+        qaxis = fb.field_scalar(q, 6, "i32", default=0)
+    raw = buffers[buf_idx] if buf_idx < len(buffers) else None
+    const = None
+    if raw is not None and raw.size:
+        const = raw.view(dtype)[:int(np.prod(shape, dtype=np.int64))] \
+            .reshape(shape)
+    return _Tensor(index, name, shape, dtype, scale, zero, qaxis, const)
+
+
+def parse(path: str) -> Tuple[List[_Tensor], List[_Op],
+                              List[int], List[int]]:
+    """Parse subgraph 0 into tensors / ops / input / output index lists."""
+    with open(path, "rb") as f:
+        data = f.read()
+    fb = FlatBuf(data)
+    root = fb.root()
+    # buffers (Model field 4): raw little-endian bytes per buffer
+    buffers: List[Optional[np.ndarray]] = []
+    bvec = fb.field_vector(root, 4)
+    if bvec is not None:
+        for bpos in fb.vector_tables(bvec):
+            d = fb.field_np(bpos, 0, np.uint8)
+            buffers.append(d)
+    # operator codes (Model field 1); builtin_code (3) supersedes the
+    # deprecated int8 field 0 for codes > 127
+    codes: List[int] = []
+    for cpos in fb.vector_tables(fb.field_vector(root, 1)):
+        dep = fb.field_scalar(cpos, 0, "i8")
+        builtin = fb.field_scalar(cpos, 3, "i32", default=0)
+        codes.append(builtin if builtin != 0 else dep)
+    sg = next(fb.vector_tables(fb.field_vector(root, 2)))
+    tensors = [
+        _parse_tensor(fb, tpos, i, buffers)
+        for i, tpos in enumerate(fb.vector_tables(fb.field_vector(sg, 0)))]
+    inputs = [int(i) for i in fb.field_np(sg, 1, np.int32)]
+    outputs = [int(i) for i in fb.field_np(sg, 2, np.int32)]
+    ops: List[_Op] = []
+    for opos in fb.vector_tables(fb.field_vector(sg, 3)):
+        idx = fb.field_scalar(opos, 0, "u32")
+        op_inputs = [int(i) for i in fb.field_np(opos, 1, np.int32)]
+        op_outputs = [int(i) for i in fb.field_np(opos, 2, np.int32)]
+        options = fb.field_table(opos, 4)
+        ops.append(_Op(codes[idx], op_inputs, op_outputs, options, fb))
+    return tensors, ops, inputs, outputs
+
+
+# -- dequantization --------------------------------------------------------
+
+def _dequantize_const(t: _Tensor) -> np.ndarray:
+    """Constant to float32, applying (q - zp) * scale (per-axis aware)."""
+    data = t.const
+    assert data is not None
+    if t.dtype in (np.float32, np.float64, np.float16):
+        return data.astype(np.float32)
+    if not t.quantized:
+        return data  # int32 shape/axis constants stay integer
+    scale = t.scale.astype(np.float64)
+    zp = (t.zero_point if t.zero_point is not None
+          else np.zeros_like(scale)).astype(np.float64)
+    if scale.size == 1:
+        return ((data.astype(np.float64) - zp[0]) * scale[0]) \
+            .astype(np.float32)
+    bshape = [1] * data.ndim
+    bshape[t.quant_axis] = scale.size
+    return ((data.astype(np.float64) - zp.reshape(bshape))
+            * scale.reshape(bshape)).astype(np.float32)
+
+
+# -- lowering --------------------------------------------------------------
+
+_ACT = {0: None, 1: "relu", 2: "relu_n1_to_1", 3: "relu6", 4: "tanh"}
+
+
+def _apply_act(jnp, x, act_code: int):
+    act = _ACT.get(act_code)
+    if act is None:
+        return x
+    if act == "relu":
+        return jnp.maximum(x, 0.0)
+    if act == "relu6":
+        return jnp.clip(x, 0.0, 6.0)
+    if act == "relu_n1_to_1":
+        return jnp.clip(x, -1.0, 1.0)
+    return jnp.tanh(x)
+
+
+def _pool_avg(lax, jnp, x, ksize, strides, padding):
+    ones = jnp.ones_like(x)
+    window = (1, ksize[0], ksize[1], 1)
+    strides4 = (1, strides[0], strides[1], 1)
+    s = lax.reduce_window(x, 0.0, lax.add, window, strides4, padding)
+    # average over VALID window elements only (TFLite SAME-pad semantics)
+    n = lax.reduce_window(ones, 0.0, lax.add, window, strides4, padding)
+    return s / n
+
+
+def _bilinear(jnp, x, out_h, out_w, align_corners, half_pixel):
+    n, in_h, in_w, c = x.shape
+
+    def coords(out, inp):
+        idx = jnp.arange(out, dtype=jnp.float32)
+        if align_corners and out > 1:
+            return idx * ((inp - 1) / (out - 1))
+        if half_pixel:
+            return jnp.maximum((idx + 0.5) * (inp / out) - 0.5, 0.0)
+        return idx * (inp / out)
+
+    ys, xs = coords(out_h, in_h), coords(out_w, in_w)
+    y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, in_h - 1)
+    y1 = jnp.clip(y0 + 1, 0, in_h - 1)
+    x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, in_w - 1)
+    x1 = jnp.clip(x0 + 1, 0, in_w - 1)
+    wy = (ys - y0)[None, :, None, None]
+    wx = (xs - x0)[None, None, :, None]
+    top = jnp.take(x, y0, axis=1)
+    bot = jnp.take(x, y1, axis=1)
+    tl, tr = jnp.take(top, x0, axis=2), jnp.take(top, x1, axis=2)
+    bl, br = jnp.take(bot, x0, axis=2), jnp.take(bot, x1, axis=2)
+    t = tl + (tr - tl) * wx
+    b = bl + (br - bl) * wx
+    return t + (b - t) * wy
+
+
+def _lower(tensors: List[_Tensor], ops: List[_Op],
+           graph_in: List[int], graph_out: List[int]) -> Callable:
+    """Build fn(*inputs)->list[outputs]. Constants (dequantized) are
+    closed over; inside jit XLA hoists them to device constants."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    consts: Dict[int, np.ndarray] = {
+        t.index: _dequantize_const(t) for t in tensors
+        if t.const is not None}
+    for t in tensors:
+        # drop the raw quantized views: fn closes over `tensors` only for
+        # shape/quant metadata, keeping both copies would double-retain
+        # the weights for the model's lifetime
+        t.const = None
+
+    def fn(*args):
+        env: Dict[int, Any] = {}
+        for i, gi in enumerate(graph_in):
+            t = tensors[gi]
+            x = args[i]
+            if t.quantized and t.dtype in (np.uint8, np.int8):
+                # boundary dequantize: wire dtype -> float simulation
+                x = (x.astype(jnp.float32) - float(t.zero_point[0])) \
+                    * float(t.scale[0])
+            elif x.dtype != jnp.float32 and t.dtype == np.float32:
+                x = x.astype(jnp.float32)
+            env[gi] = x
+
+        def val(idx: int):
+            if idx in env:
+                return env[idx]
+            if idx in consts:
+                return consts[idx]
+            raise KeyError(
+                f"tensor {idx} used before produced "
+                f"({tensors[idx].name!r})")
+
+        def const_val(idx: int) -> np.ndarray:
+            if idx in consts:
+                return consts[idx]
+            v = env.get(idx)
+            if isinstance(v, np.ndarray):
+                return v
+            raise NotImplementedError(
+                f"op needs compile-time constant for tensor {idx} "
+                f"({tensors[idx].name!r})")
+
+        for op in ops:
+            y = _eval_op(op, val, const_val, tensors, jnp, lax)
+            t = tensors[op.outputs[0]]
+            if t.quantized and t.dtype in (np.uint8, np.int8) and \
+                    t.scale.size == 1:
+                # quantized storage saturates activations to the tensor's
+                # representable range — the float simulation must too, or
+                # deep nets drift (this is also how TFLite bakes ReLU6
+                # into quant ranges instead of explicit activation ops)
+                info = np.iinfo(t.dtype)
+                zp = float(t.zero_point[0]) if t.zero_point is not None \
+                    else 0.0
+                s = float(t.scale[0])
+                y = jnp.clip(y, (info.min - zp) * s, (info.max - zp) * s)
+            env[op.outputs[0]] = y
+
+        outs = []
+        for go in graph_out:
+            y = val(go)
+            t = tensors[go]
+            if t.quantized and t.dtype in (np.uint8, np.int8):
+                # boundary requantize back to the declared wire dtype
+                info = np.iinfo(t.dtype)
+                q = jnp.round(y / float(t.scale[0])) + float(t.zero_point[0])
+                y = jnp.clip(q, info.min, info.max).astype(t.dtype)
+            outs.append(y)
+        return outs
+
+    return fn
+
+
+def _eval_op(op: _Op, val, const_val, tensors, jnp, lax):
+    fb, opt = op.fb, op.options
+    code = op.code
+
+    def scalar(fid, kind, default=0):
+        if opt is None:
+            return default
+        return fb.field_scalar(opt, fid, kind, default=default)
+
+    if code == CONV_2D:
+        x = val(op.inputs[0])
+        w = const_val(op.inputs[1])           # OHWI
+        padding = "SAME" if scalar(0, "i8") == 0 else "VALID"
+        strides = (scalar(2, "i32", 1), scalar(1, "i32", 1))  # (h, w)
+        dil = (scalar(5, "i32", 1) or 1, scalar(4, "i32", 1) or 1)
+        y = lax.conv_general_dilated(
+            x, jnp.asarray(np.transpose(w, (1, 2, 3, 0))),  # -> HWIO
+            window_strides=strides, padding=padding, rhs_dilation=dil,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32)
+        if len(op.inputs) > 2 and op.inputs[2] >= 0:
+            y = y + const_val(op.inputs[2])
+        return _apply_act(jnp, y, scalar(3, "i8"))
+
+    if code == DEPTHWISE_CONV_2D:
+        x = val(op.inputs[0])
+        w = const_val(op.inputs[1])           # [1, kh, kw, in*mult]
+        padding = "SAME" if scalar(0, "i8") == 0 else "VALID"
+        strides = (scalar(2, "i32", 1), scalar(1, "i32", 1))
+        dil = (scalar(6, "i32", 1) or 1, scalar(5, "i32", 1) or 1)
+        in_ch = x.shape[-1]
+        y = lax.conv_general_dilated(
+            x, jnp.asarray(np.transpose(w, (1, 2, 0, 3))),  # -> HW1(in*mult)
+            window_strides=strides, padding=padding, rhs_dilation=dil,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=in_ch,
+            preferred_element_type=jnp.float32)
+        if len(op.inputs) > 2 and op.inputs[2] >= 0:
+            y = y + const_val(op.inputs[2])
+        return _apply_act(jnp, y, scalar(4, "i8"))
+
+    if code == FULLY_CONNECTED:
+        x = val(op.inputs[0])
+        w = const_val(op.inputs[1])           # [out, in]
+        if x.ndim > 2 and not scalar(2, "i8"):
+            x = x.reshape(-1, w.shape[1])
+        y = x @ jnp.asarray(w).T
+        if len(op.inputs) > 2 and op.inputs[2] >= 0:
+            y = y + const_val(op.inputs[2])
+        return _apply_act(jnp, y, scalar(0, "i8"))
+
+    if code in (ADD, SUB, MUL, DIV, MAXIMUM, MINIMUM, POW,
+                SQUARED_DIFFERENCE):
+        a, b = val(op.inputs[0]), val(op.inputs[1])
+        if code == ADD:
+            y = a + b
+        elif code == SUB:
+            y = a - b
+        elif code == MUL:
+            y = a * b
+        elif code == DIV:
+            y = a / b
+        elif code == MAXIMUM:
+            y = jnp.maximum(a, b)
+        elif code == MINIMUM:
+            y = jnp.minimum(a, b)
+        elif code == POW:
+            y = a ** b
+        else:
+            y = (a - b) ** 2
+        # ADD/SUB/MUL/DIV carry a fused activation at options field 0
+        if code in (ADD, SUB, MUL, DIV):
+            y = _apply_act(jnp, y, scalar(0, "i8"))
+        return y
+
+    if code in (AVERAGE_POOL_2D, MAX_POOL_2D):
+        x = val(op.inputs[0])
+        padding = "SAME" if scalar(0, "i8") == 0 else "VALID"
+        strides = (scalar(2, "i32", 1), scalar(1, "i32", 1))
+        ksize = (scalar(4, "i32", 1), scalar(3, "i32", 1))
+        if code == AVERAGE_POOL_2D:
+            y = _pool_avg(lax, jnp, x, ksize, strides, padding)
+        else:
+            y = lax.reduce_window(
+                x, -jnp.inf, lax.max, (1, *ksize, 1), (1, *strides, 1),
+                padding)
+        return _apply_act(jnp, y, scalar(5, "i8"))
+
+    if code == RESHAPE:
+        x = val(op.inputs[0])
+        if opt is not None and fb.field_vector(opt, 0) is not None:
+            shape = [int(d) for d in fb.field_np(opt, 0, np.int32)]
+        else:
+            shape = [int(d) for d in const_val(op.inputs[1])]
+        return x.reshape(shape)
+
+    if code == SQUEEZE:
+        x = val(op.inputs[0])
+        dims = (fb.field_np(opt, 0, np.int32)
+                if opt is not None else None)
+        if dims is None or len(dims) == 0:
+            return jnp.squeeze(x)
+        return jnp.squeeze(x, axis=tuple(int(d) for d in dims))
+
+    if code == EXPAND_DIMS:
+        return jnp.expand_dims(val(op.inputs[0]),
+                               int(const_val(op.inputs[1])))
+
+    if code == SOFTMAX:
+        beta = scalar(0, "f32", 1.0) or 1.0
+        return jax_softmax(jnp, val(op.inputs[0]) * beta)
+
+    if code == LOG_SOFTMAX:
+        x = val(op.inputs[0])
+        return x - jnp.log(jnp.sum(jnp.exp(x - x.max(-1, keepdims=True)),
+                                   -1, keepdims=True)) \
+            - x.max(-1, keepdims=True)
+
+    if code == CONCATENATION:
+        axis = scalar(0, "i32")
+        parts = [val(i) for i in op.inputs]
+        return _apply_act(jnp, jnp.concatenate(parts, axis=axis),
+                          scalar(1, "i8"))
+
+    if code in (RESIZE_BILINEAR, RESIZE_NEAREST_NEIGHBOR):
+        x = val(op.inputs[0])
+        out_h, out_w = (int(d) for d in const_val(op.inputs[1]))
+        align = bool(scalar(2, "u8"))
+        half = bool(scalar(3, "u8"))
+        if code == RESIZE_BILINEAR:
+            return _bilinear(jnp, x, out_h, out_w, align, half)
+        method = "nearest"
+        import jax.image as jimage
+        return jimage.resize(x, (x.shape[0], out_h, out_w, x.shape[3]),
+                             method=method)
+
+    if code == PAD:
+        x = val(op.inputs[0])
+        pads = const_val(op.inputs[1]).astype(int)
+        return jnp.pad(x, [(int(a), int(b)) for a, b in pads])
+
+    if code in (MEAN, SUM):
+        x = val(op.inputs[0])
+        axes = tuple(int(a) for a in np.atleast_1d(const_val(op.inputs[1])))
+        keep = bool(scalar(0, "u8"))
+        red = jnp.mean if code == MEAN else jnp.sum
+        return red(x, axis=axes, keepdims=keep)
+
+    if code == TRANSPOSE:
+        perm = [int(p) for p in const_val(op.inputs[1])]
+        return jnp.transpose(val(op.inputs[0]), perm)
+
+    if code == RELU:
+        return jnp.maximum(val(op.inputs[0]), 0.0)
+    if code == RELU6:
+        return jnp.clip(val(op.inputs[0]), 0.0, 6.0)
+    if code == LOGISTIC:
+        return 1.0 / (1.0 + jnp.exp(-val(op.inputs[0])))
+    if code == TANH:
+        return jnp.tanh(val(op.inputs[0]))
+    if code == HARD_SWISH:
+        x = val(op.inputs[0])
+        return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+    if code == LEAKY_RELU:
+        alpha = scalar(0, "f32", 0.01)
+        x = val(op.inputs[0])
+        return jnp.where(x >= 0, x, alpha * x)
+    if code == PRELU:
+        x, a = val(op.inputs[0]), val(op.inputs[1])
+        return jnp.where(x >= 0, x, a * x)
+    if code == ABS:
+        return jnp.abs(val(op.inputs[0]))
+    if code == EXP:
+        return jnp.exp(val(op.inputs[0]))
+
+    if code == ARG_MAX:
+        axis = int(const_val(op.inputs[1]))
+        out_t = tensors[op.outputs[0]].dtype
+        return jnp.argmax(val(op.inputs[0]), axis=axis).astype(out_t)
+
+    if code == CAST:
+        return val(op.inputs[0]).astype(tensors[op.outputs[0]].dtype)
+
+    if code in (DEQUANTIZE, QUANTIZE):
+        # float simulation: activations are already float end-to-end
+        return val(op.inputs[0])
+
+    if code == SHAPE:
+        return np.asarray(tensors[op.inputs[0]].shape
+                          if tensors[op.inputs[0]].shape
+                          else val(op.inputs[0]).shape,
+                          tensors[op.outputs[0]].dtype)
+
+    if code == BROADCAST_ARGS:
+        a = const_val(op.inputs[0])
+        b = const_val(op.inputs[1])
+        return np.asarray(
+            np.broadcast_shapes(tuple(int(x) for x in a),
+                                tuple(int(x) for x in b)),
+            tensors[op.outputs[0]].dtype)
+
+    if code == BROADCAST_TO:
+        shape = [int(d) for d in const_val(op.inputs[1])]
+        return jnp.broadcast_to(val(op.inputs[0]), shape)
+
+    if code == PACK:
+        axis = scalar(1, "i32")
+        return jnp.stack([val(i) for i in op.inputs], axis=axis)
+
+    if code == SLICE:
+        x = val(op.inputs[0])
+        begin = [int(b) for b in const_val(op.inputs[1])]
+        size = [int(s) for s in const_val(op.inputs[2])]
+        idx = tuple(slice(b, x.shape[d] if s == -1 else b + s)
+                    for d, (b, s) in enumerate(zip(begin, size)))
+        return x[idx]
+
+    if code == STRIDED_SLICE:
+        x = val(op.inputs[0])
+        begin = [int(b) for b in const_val(op.inputs[1])]
+        end = [int(e) for e in const_val(op.inputs[2])]
+        strides = [int(s) for s in const_val(op.inputs[3])]
+        bm = scalar(0, "i32")
+        em = scalar(1, "i32")
+        shrink = scalar(4, "i32")
+        idx = []
+        for d in range(len(begin)):
+            if shrink & (1 << d):
+                idx.append(begin[d])
+                continue
+            b = None if bm & (1 << d) else begin[d]
+            e = None if em & (1 << d) else end[d]
+            idx.append(slice(b, e, strides[d]))
+        return x[tuple(idx)]
+
+    if code == BATCH_MATMUL:
+        a, b = val(op.inputs[0]), val(op.inputs[1])
+        adj_x = bool(scalar(0, "u8"))
+        adj_y = bool(scalar(1, "u8"))
+        if adj_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if adj_y:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b)
+
+    if code == TRANSPOSE_CONV:
+        out_shape = [int(d) for d in const_val(op.inputs[0])]
+        w = const_val(op.inputs[1])           # OHWI
+        x = val(op.inputs[2])
+        padding = "SAME" if scalar(0, "i8") == 0 else "VALID"
+        strides = (scalar(2, "i32", 1), scalar(1, "i32", 1))
+        y = lax.conv_transpose(
+            x, jnp.asarray(np.transpose(w, (1, 2, 3, 0))),
+            strides, padding, dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            transpose_kernel=True)
+        if len(op.inputs) > 3 and op.inputs[3] >= 0:
+            y = y + const_val(op.inputs[3])
+        return y[:, :out_shape[1], :out_shape[2], :]
+
+    raise NotImplementedError(
+        f"tflite op {_OP_NAMES.get(code, code)} ({code}) not supported")
+
+
+def jax_softmax(jnp, x):
+    m = x.max(axis=-1, keepdims=True)
+    e = jnp.exp(x - m)
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+# -- public API ------------------------------------------------------------
+
+def _info_of(tensors: List[_Tensor], indices: List[int]) -> TensorsInfo:
+    infos = TensorsInfo()
+    for i in indices:
+        t = tensors[i]
+        infos.append(TensorInfo(
+            name=t.name or None,
+            type=TensorType.from_dtype(np.dtype(t.dtype)),
+            shape=tuple(t.shape)))
+    return infos
+
+
+def load(path: str) -> TFLiteModel:
+    """Parse + lower a .tflite file to a jittable function."""
+    tensors, ops, graph_in, graph_out = parse(path)
+    fn = _lower(tensors, ops, graph_in, graph_out)
+    return TFLiteModel(
+        fn=fn,
+        input_info=_info_of(tensors, graph_in),
+        output_info=_info_of(tensors, graph_out),
+        path=path)

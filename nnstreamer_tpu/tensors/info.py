@@ -104,8 +104,18 @@ class TensorInfo:
         return serialize_dimension(self.shape, rank)
 
     def is_equal(self, other: "TensorInfo") -> bool:
-        """Type+shape equality, ignoring names (ref: gst_tensor_info_is_equal)."""
-        return self.type == other.type and self.shape == other.shape
+        """Type+shape equality, ignoring names and size-1 padding dims
+        (ref: gst_tensor_info_is_equal — dims compare padded with 1s to
+        rank 16; in numpy order the padding 1s are leading)."""
+
+        def norm(shape: Tuple[int, ...]) -> Tuple[int, ...]:
+            s = tuple(shape)
+            while len(s) > 1 and s[0] == 1:
+                s = s[1:]
+            return s
+
+        return self.type == other.type and \
+            norm(self.shape) == norm(other.shape)
 
     def copy(self) -> "TensorInfo":
         return TensorInfo(self.name, self.type, tuple(self.shape))

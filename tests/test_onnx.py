@@ -1,0 +1,57 @@
+"""onnxruntime interop backend: importer + golden-label pipeline parity.
+
+Mirrors tests/nnstreamer_filter_onnxruntime/runTest.sh:74-76 — the full
+reference preprocessing chain (transpose HWC->CHW, /127.5 - 1.0) into the
+quantized MobileNet-v2 ONNX model, asserting the 'orange' label."""
+import os
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import parse_launch
+from nnstreamer_tpu.filters import FilterProperties, detect_framework, find_filter
+
+REF = "/root/reference/tests/test_models"
+MODELS = os.path.join(REF, "models")
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(MODELS), reason="reference test models unavailable")
+
+MOBILENET = os.path.join(MODELS, "mobilenet_v2_quant.onnx")
+
+
+def test_importer_model_info():
+    from nnstreamer_tpu.interop import onnx
+    m = onnx.load(MOBILENET)
+    assert tuple(m.input_info[0].shape) == (1, 3, 224, 224)
+    assert tuple(m.output_info[0].shape) == (1, 1000)
+
+
+def test_backend_invoke():
+    fw = find_filter("onnxruntime")()
+    fw.open(FilterProperties(framework="onnxruntime",
+                             model_files=(MOBILENET,)))
+    out = fw.invoke([np.zeros((1, 3, 224, 224), np.float32)])
+    assert np.asarray(out[0]).shape == (1, 1000)
+    fw.close()
+
+
+def test_extension_auto_detect():
+    assert detect_framework((MOBILENET,)) == "onnxruntime"
+
+
+def test_golden_onnx_orange_label():
+    """runTest.sh case 1: pngdec -> scale -> RGB -> converter ->
+    transpose 1:2:0:3 -> typecast/div/add -> onnx filter -> label."""
+    pipe = parse_launch(
+        f'filesrc location={REF}/data/orange.png ! pngdec '
+        '! videoscale width=224 height=224 ! videoconvert format=RGB '
+        '! tensor_converter '
+        '! tensor_transform mode=transpose option=1:2:0:3 '
+        '! tensor_transform mode=arithmetic '
+        'option=typecast:float32,div:127.5,add:-1.0 '
+        f'! tensor_filter framework=onnxruntime model={MOBILENET} '
+        '! tensor_decoder mode=image_labeling '
+        f'option1={REF}/labels/labels.txt ! appsink name=out')
+    pipe.run(timeout=300)
+    bufs = pipe["out"].buffers
+    assert bufs and bufs[-1].extras["label"] == "orange"

@@ -1,0 +1,112 @@
+"""tensorflow-lite interop backend: importer correctness + golden-label
+pipeline parity.
+
+Mirrors the reference's TFLite suites: model loading and invoke
+(tests/nnstreamer_filter_tensorflow2_lite/unittest_tensorflow2_lite.cc)
+and the SSAT golden pipeline asserting the MobileNet label on a real
+image (tests/nnstreamer_filter_tensorflow2_lite/runTest.sh:69-80 +
+checkLabel.py). Uses the reference's checked-in model/data artifacts
+read-only."""
+import os
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import parse_launch
+from nnstreamer_tpu.filters import FilterProperties, detect_framework, find_filter
+
+REF = "/root/reference/tests/test_models"
+MODELS = os.path.join(REF, "models")
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(MODELS), reason="reference test models unavailable")
+
+
+def _model(name):
+    return os.path.join(MODELS, name)
+
+
+def test_importer_add():
+    from nnstreamer_tpu.interop import tflite
+    m = tflite.load(_model("add.tflite"))
+    out = m.fn(np.array([1.5], np.float32))
+    np.testing.assert_allclose(np.asarray(out[0]), [3.5])
+
+
+def test_importer_multi_io():
+    from nnstreamer_tpu.interop import tflite
+    m = tflite.load(_model("sample_4x4x4x4x4_two_input_one_output.tflite"))
+    assert len(m.input_info) == 2 and len(m.output_info) == 1
+    a = np.full((1, 4, 4, 4, 4, 4), 2.0, np.float32)
+    b = np.full((1, 4, 4, 4, 4, 4), 0.5, np.float32)
+    out = m.fn(a, b)
+    np.testing.assert_allclose(np.asarray(out[0]),
+                               np.full((1, 4, 4, 4, 4, 4), 2.5))
+
+
+def test_importer_32_in_32_out():
+    from nnstreamer_tpu.interop import tflite
+    m = tflite.load(_model("simple_32_in_32_out.tflite"))
+    assert len(m.input_info) == 32 and len(m.output_info) == 32
+    xs = [np.ones(i.shape, i.type.np_dtype) for i in m.input_info]
+    outs = m.fn(*xs)
+    assert len(outs) == 32
+
+
+def test_backend_model_info_and_invoke():
+    fw = find_filter("tensorflow2-lite")()  # reference property alias
+    fw.open(FilterProperties(
+        framework="tensorflow-lite",
+        model_files=(_model("mobilenet_v2_1.0_224_quant.tflite"),)))
+    in_info, out_info = fw.get_model_info()
+    assert tuple(in_info[0].shape) == (1, 224, 224, 3)
+    assert tuple(out_info[0].shape) == (1, 1001)
+    out = fw.invoke([np.zeros((224, 224, 3), np.uint8)])
+    assert np.asarray(out[0]).shape == (1, 1001)
+    fw.close()
+
+
+def test_extension_auto_detect():
+    assert detect_framework((_model("add.tflite"),)) == "tensorflow-lite"
+
+
+def test_golden_mobilenet_orange_label(tmp_path):
+    """The reference golden test: PNG -> scale -> convert -> tensor ->
+    mobilenet quant -> label must be 'orange' (runTest.sh:77-79)."""
+    out_log = tmp_path / "tensorfilter.out.log"
+    pipe = parse_launch(
+        f'filesrc location={REF}/data/orange.png ! pngdec '
+        '! videoscale width=224 height=224 ! videoconvert format=RGB '
+        '! tensor_converter '
+        '! tensor_filter framework=tensorflow2-lite '
+        f'model={_model("mobilenet_v2_1.0_224_quant.tflite")} '
+        f'! filesink location={out_log}')
+    pipe.run(timeout=300)
+    # checkLabel.py semantics: argmax index of the dumped byte scores
+    scores = np.frombuffer(out_log.read_bytes(), np.uint8)
+    assert scores.size == 1001
+    labels = [line.strip() for line in
+              open(os.path.join(REF, "labels", "labels.txt"))]
+    assert labels[int(np.argmax(scores))] == "orange"
+
+
+def test_golden_decoder_label(tmp_path):
+    """Same pipeline through the image_labeling decoder element."""
+    pipe = parse_launch(
+        f'filesrc location={REF}/data/orange.png ! pngdec '
+        '! videoscale width=224 height=224 '
+        '! tensor_converter '
+        '! tensor_filter framework=tensorflow-lite '
+        f'model={_model("mobilenet_v2_1.0_224_quant.tflite")} '
+        '! tensor_decoder mode=image_labeling '
+        f'option1={REF}/labels/labels.txt ! appsink name=out')
+    pipe.run(timeout=300)
+    bufs = pipe["out"].buffers
+    assert bufs and bufs[-1].extras["label"] == "orange"
+
+
+def test_deeplab_imports_and_runs():
+    from nnstreamer_tpu.interop import tflite
+    m = tflite.load(_model("deeplabv3_257_mv_gpu.tflite"))
+    assert tuple(m.output_info[0].shape) == (1, 257, 257, 21)
+    out = m.fn(np.zeros((1, 257, 257, 3), np.float32))
+    assert np.asarray(out[0]).shape == (1, 257, 257, 21)
