@@ -179,6 +179,11 @@ class _CollectBase(Element):
 
     def _collect_synced(self, pads, mode) -> bool:
         sts = [self._state(p) for p in pads]
+        # GstCollectPads gate: collection fires only when every live
+        # (non-EOS) pad has queued data — collecting earlier would have
+        # to abort halfway and lose the buffers it already consumed
+        if any(not st.queue and not st.eos for st in sts):
+            return False
         # pick current (base) timestamp
         if mode == "basepad":
             opt = (self.sync_option or "0").split(":")
@@ -189,46 +194,60 @@ class _CollectBase(Element):
             if not bst.queue:
                 return False
             current = bst.queue[0].pts or 0
-            # the configured duration IS the window (≙ reference basepad
-            # semantics); fall back to a PTS-delta heuristic only when no
-            # duration was given, clamped >= 0 so equal consecutive base
-            # PTS can't wedge every other pad on stale buffers
-            if len(opt) > 1 and opt[1]:
-                base_win = int(opt[1])
-            elif bst.last is not None:
-                base_win = max(0, abs(current - (bst.last.pts or 0)) - 1)
+            # ≙ nnstreamer_plugin_api_impl.c:368-377 — the window is
+            # MIN(duration, ABS(pts_delta)-1), assigned only once the base
+            # pad has a previous buffer; before that it stays 0.  The
+            # delta term is clamped >= 0 (reference leaves -1 for equal
+            # consecutive PTS) so stale buffers can't wedge other pads.
+            duration = int(opt[1]) if len(opt) > 1 and opt[1] else None
+            if bst.last is not None:
+                delta_win = max(0, abs(current - (bst.last.pts or 0)) - 1)
+                base_win = delta_win if duration is None \
+                    else min(duration, delta_win)
             else:
                 base_win = 0
         else:
-            if any(not st.queue and not st.eos for st in sts):
-                return False
             heads = [st.queue[0].pts or 0 for st in sts if st.queue]
             if not heads:
                 return False
             current = max(heads)
             base_win = 0
 
-        # per-pad buffer update (≙ _gst_tensor_time_sync_buffer_update)
-        chosen: List[Optional[Buffer]] = []
+        # per-pad buffer update (≙ _gst_tensor_time_sync_buffer_update),
+        # two-phase: decide every pad's contribution by peeking, and only
+        # commit (pop queues / advance .last) once the whole tuple is
+        # known to be assemblable — an aborted collection must not
+        # consume buffers, or tuples are silently lost
+        chosen: List[Buffer] = []
+        plans: List[tuple] = []  # (n_outdated_pops, take_head)
         for st in sts:
-            while st.queue and (st.queue[0].pts or 0) < current:
-                st.last = st.queue.popleft()
-            if st.queue:
-                head = st.queue[0]
-                if mode == "slowest" and st.last is not None and \
-                        abs(current - (st.last.pts or 0)) < \
+            q = st.queue
+            k = 0
+            while k < len(q) and (q[k].pts or 0) < current:
+                k += 1
+            last = q[k - 1] if k else st.last
+            take = False
+            if k < len(q):
+                head = q[k]
+                if mode == "slowest" and last is not None and \
+                        abs(current - (last.pts or 0)) < \
                         abs(current - (head.pts or 0)):
                     pass  # keep last
-                elif mode == "basepad" and st.last is not None and \
+                elif mode == "basepad" and last is not None and \
                         abs((head.pts or 0) - current) > base_win:
                     pass  # out of window: keep last
                 else:
-                    st.last = st.queue.popleft()
+                    take = True
             elif not st.eos:
                 return False  # need more data to decide
-            if st.last is None:
+            buf = head if take else last
+            if buf is None:
                 return False
-            chosen.append(st.last)
+            plans.append((k, take))
+            chosen.append(buf)
+        for st, (k, take) in zip(sts, plans):
+            for _ in range(k + (1 if take else 0)):
+                st.last = st.queue.popleft()
         self._emit(pads, chosen, current)
         return True
 

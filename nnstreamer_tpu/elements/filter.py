@@ -21,7 +21,7 @@ from ..filters.registry import (detect_framework, find_filter,
                                 shared_model_release)
 from ..tensors.buffer import Buffer, Chunk
 from ..tensors.caps import Caps
-from ..tensors.info import TensorsConfig, TensorsInfo
+from ..tensors.info import TensorInfo, TensorsConfig, TensorsInfo
 from ..tensors.types import TensorFormat
 from ..pipeline.element import Element
 from ..pipeline.pad import Pad
@@ -68,6 +68,7 @@ class TensorFilter(Element):
         self._watchdog: Optional[Watchdog] = None
         self._in_combi: Optional[List[int]] = None
         self._out_combi: Optional[List[str]] = None
+        self._batch: Optional[int] = None  # batched-invoke leading dim
 
     # -- framework lifecycle ---------------------------------------------
     def _open_fw(self) -> None:
@@ -137,19 +138,47 @@ class TensorFilter(Element):
             self.fw = None
 
     # -- negotiation ------------------------------------------------------
+    def _infer_batch(self, sel: TensorsInfo) -> Optional[int]:
+        """If the stream is the model input plus one leading (outermost)
+        batch dim on every tensor, return that batch size.
+
+        TPU-first batched invoke: tensor_aggregator (or a batched source)
+        stacks N frames; the whole stack goes through ONE executable
+        dispatch, which is how the MXU earns its keep — the reference has
+        no analog (its backends are handed exactly the model shape).
+        Only backends declaring SUPPORTS_BATCH negotiate this; others keep
+        the fail-fast caps mismatch error."""
+        if not getattr(self.fw, "SUPPORTS_BATCH", False):
+            return None
+        if self._in_info is None or len(sel) != len(self._in_info):
+            return None
+        b = None
+        for s, m in zip(sel, self._in_info):
+            if s.type != m.type or len(s.shape) != len(m.shape) + 1 \
+                    or tuple(s.shape[1:]) != tuple(m.shape):
+                return None
+            if b is None:
+                b = int(s.shape[0])
+            elif int(s.shape[0]) != b:
+                return None
+        return b
+
     def on_sink_caps(self, pad: Pad, caps: Caps) -> None:
         self._open_fw()
         cfg = caps.to_config()
+        self._batch = None
         if self._in_info is not None and cfg.format == TensorFormat.STATIC:
             sel = cfg.info
             if self._in_combi:
                 sel = TensorsInfo(cfg.info[i] for i in self._in_combi)
             if len(sel) and not sel.is_equal(self._in_info):
-                raise ValueError(
-                    f"{self.name}: model input {self._in_info!r} does not match "
-                    f"negotiated stream caps {sel!r}. Check tensor_converter/"
-                    "tensor_transform output dims, or set input/inputtype "
-                    "properties explicitly.")
+                self._batch = self._infer_batch(sel)
+                if self._batch is None:
+                    raise ValueError(
+                        f"{self.name}: model input {self._in_info!r} does not match "
+                        f"negotiated stream caps {sel!r}. Check tensor_converter/"
+                        "tensor_transform output dims, or set input/inputtype "
+                        "properties explicitly.")
         elif self._in_info is None:
             # push-path: derive model info from caps (SET_INPUT_INFO analog)
             self._in_info = cfg.info
@@ -160,7 +189,12 @@ class TensorFilter(Element):
             out_cfg = TensorsConfig(TensorsInfo(), TensorFormat.FLEXIBLE,
                                     cfg.rate_n, cfg.rate_d)
         else:
-            out_cfg = TensorsConfig(self._out_info.copy(), TensorFormat.STATIC,
+            out_info = self._out_info.copy()
+            if self._batch is not None:
+                out_info = TensorsInfo(
+                    TensorInfo(i.name, i.type, (self._batch,) + tuple(i.shape))
+                    for i in out_info)
+            out_cfg = TensorsConfig(out_info, TensorFormat.STATIC,
                                     cfg.rate_n, cfg.rate_d)
         self.set_src_caps(Caps.from_config(out_cfg))
 

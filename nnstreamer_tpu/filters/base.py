@@ -17,6 +17,21 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 from ..tensors.info import TensorsInfo
 
 
+def parse_custom_properties(s: str) -> Dict[str, str]:
+    """``k:v,k:v`` custom option string (the reference's custom-prop
+    grammar, e.g. ``custom=mesh:2x1x4,rules:gpt``); a bare key maps to
+    ``"true"``."""
+    out: Dict[str, str] = {}
+    for part in (s or "").split(","):
+        part = part.strip()
+        if ":" in part:
+            k, v = part.split(":", 1)
+            out[k.strip()] = v.strip()
+        elif part:
+            out[part] = "true"
+    return out
+
+
 class FilterEvent(enum.Enum):
     """(ref: event_ops enum, nnstreamer_plugin_api_filter.h:205-217)"""
 
@@ -96,6 +111,10 @@ class FilterFramework:
     # (ref: gst_tensor_filter_detect_framework, tensor_filter_common.c:1174)
     EXTENSIONS: Tuple[str, ...] = ()
     AVAILABLE = True
+    # True when invoke() accepts inputs with one extra leading batch dim
+    # (the element then negotiates aggregator-stacked streams); backends
+    # that lower to a fixed model shape must leave this False
+    SUPPORTS_BATCH = False
 
     def open(self, props: FilterProperties) -> None:
         raise NotImplementedError

@@ -17,6 +17,15 @@ Model URIs accepted by the ``model`` property:
 
 Outputs stay device-resident (jax.Array) so chained elements keep HBM
 residency; they materialize only at host boundaries.
+
+**Mesh mode** (multi-chip invoke): ``custom=mesh:<dp>x<sp>x<tp>`` (or
+``mesh:auto``) builds a `jax.sharding.Mesh`, places params by the
+``rules:`` table (``gpt`` = Megatron TP from parallel/sharding.py;
+default = replicate), and shards the input batch over the ``data`` axis,
+so one invoke fans out over every chip with XLA inserting the ICI
+collectives. This is the TPU-native answer to the reference's
+among-device stream fan-out (ref: tensor_query/README.md:5-27 — there,
+frames are RPC'd to other devices; here the mesh IS the device pool).
 """
 from __future__ import annotations
 
@@ -30,7 +39,9 @@ import numpy as np
 
 from ..tensors.info import TensorsInfo
 from ..utils.log import logger
-from .base import Accelerator, FilterEvent, FilterFramework, FilterProperties
+from .base import (Accelerator, FilterEvent, FilterFramework,
+                   FilterProperties,
+                   parse_custom_properties as _parse_custom)
 from .registry import register_filter
 
 
@@ -47,12 +58,37 @@ def _device_for(accelerators: Sequence[Accelerator]):
     return jax.devices()[0]
 
 
+def _build_mesh(spec: str):
+    """``2x2x2`` -> Mesh(dp=2, sp=2, tp=2); ``auto`` factors all devices."""
+    from ..parallel import mesh as meshlib
+    if spec in ("auto", "true"):
+        return meshlib.best_mesh()
+    dims = [int(d) for d in spec.lower().split("x")]
+    while len(dims) < 3:
+        dims.append(1)
+    return meshlib.make_mesh(tuple(dims[:3]))
+
+
+_RULE_TABLES: Dict[str, Any] = {}
+
+
+def _rules_for(name: str):
+    if not _RULE_TABLES:
+        from ..parallel import sharding as sh
+        _RULE_TABLES.update({"gpt": sh.GPT_RULES, "none": [], "": []})
+    if name not in _RULE_TABLES:
+        raise ValueError(f"unknown sharding rule table {name!r} "
+                         f"(have: {sorted(_RULE_TABLES)})")
+    return _RULE_TABLES[name]
+
+
 @register_filter
 class JaxFilter(FilterFramework):
     """framework=jax (aliases: jax-tpu). The flagship backend."""
 
     NAME = "jax"
     EXTENSIONS = (".py", ".jaxm", ".msgpack")
+    SUPPORTS_BATCH = True  # apply fns broadcast over a leading batch dim
 
     def __init__(self):
         self._apply: Optional[Callable] = None
@@ -61,6 +97,8 @@ class JaxFilter(FilterFramework):
         self._out_info: Optional[TensorsInfo] = None
         self._jit_cache: Dict[Tuple, Any] = {}
         self._device = None
+        self._mesh = None
+        self._param_sharding = None
         self._props: Optional[FilterProperties] = None
         self._lock = threading.Lock()
         self._suspended = False
@@ -69,12 +107,26 @@ class JaxFilter(FilterFramework):
     def open(self, props: FilterProperties) -> None:
         import jax
         self._props = props
-        self._device = _device_for(props.accelerators)
+        opts = _parse_custom(props.custom_properties)
         model = props.model_files[0] if props.model_files else ""
         self._load_model(model, props)
-        if self._params is not None:
-            self._params = jax.device_put(self._params, self._device)
-        logger.info("jax filter opened model=%s on %s", model, self._device)
+        if "mesh" in opts:
+            from ..parallel.sharding import named_sharding_tree
+            self._mesh = _build_mesh(opts["mesh"])
+            rules = _rules_for(opts.get("rules", ""))
+            self._param_sharding = named_sharding_tree(
+                self._params, rules, self._mesh)
+            if self._params is not None:
+                self._params = jax.device_put(self._params,
+                                              self._param_sharding)
+            logger.info("jax filter opened model=%s on mesh %s", model,
+                        dict(self._mesh.shape))
+        else:
+            self._device = _device_for(props.accelerators)
+            if self._params is not None:
+                self._params = jax.device_put(self._params, self._device)
+            logger.info("jax filter opened model=%s on %s", model,
+                        self._device)
 
     def _load_model(self, model: str, props: FilterProperties) -> None:
         if model.startswith("zoo://"):
@@ -131,13 +183,33 @@ class JaxFilter(FilterFramework):
             self._jit_cache[sig] = exe
         return exe
 
+    def _input_sharding(self, x):
+        """Shard the batch (dim 0) over the ``data`` axis when divisible;
+        replicate otherwise. XLA propagates from these committed inputs +
+        the param shardings and inserts the ICI collectives."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        ndp = self._mesh.shape.get("data", 1)
+        if x.ndim > 0 and ndp > 1 and x.shape[0] % ndp == 0:
+            return NamedSharding(self._mesh,
+                                 P("data", *([None] * (x.ndim - 1))))
+        return NamedSharding(self._mesh, P())
+
     def invoke(self, inputs: Sequence[Any]) -> List[Any]:
         import jax
         with self._lock:
             if self._suspended:
                 self._resume()
-            xs = [x if isinstance(x, jax.Array) else
-                  jax.device_put(np.asarray(x), self._device) for x in inputs]
+            if self._mesh is not None:
+                # keep jax.Arrays device-resident: _input_sharding only
+                # reads shape/ndim, and device_put reshards on device
+                xs = [jax.device_put(
+                          x if isinstance(x, jax.Array) else np.asarray(x),
+                          self._input_sharding(x))
+                      for x in inputs]
+            else:
+                xs = [x if isinstance(x, jax.Array) else
+                      jax.device_put(np.asarray(x), self._device)
+                      for x in inputs]
             sig = tuple((tuple(x.shape), str(x.dtype)) for x in xs)
             out = self._executable(sig)(self._params, *xs)
         if isinstance(out, (list, tuple)):
@@ -156,6 +228,9 @@ class JaxFilter(FilterFramework):
             with self._lock:
                 self._apply, self._params = fresh._apply, fresh._params
                 self._in_info, self._out_info = fresh._in_info, fresh._out_info
+                self._mesh = fresh._mesh
+                self._param_sharding = fresh._param_sharding
+                self._device = fresh._device
                 self._jit_cache.clear()
             return True
         if event == FilterEvent.SUSPEND:
@@ -176,7 +251,9 @@ class JaxFilter(FilterFramework):
     def _resume(self) -> None:
         import jax
         if self._suspended:
-            self._params = jax.device_put(self._params, self._device)
+            self._params = jax.device_put(
+                self._params, self._param_sharding if self._mesh is not None
+                else self._device)
             self._suspended = False
 
 

@@ -20,17 +20,9 @@ import numpy as np
 
 from ..tensors.info import TensorsInfo
 from ..utils.log import logger
-from .base import FilterFramework, FilterProperties
+from .base import (FilterFramework, FilterProperties,
+                   parse_custom_properties as _parse_custom)
 from .registry import register_alias, register_filter
-
-
-def _parse_custom(s: str) -> Dict[str, str]:
-    out = {}
-    for part in (s or "").split(","):
-        if ":" in part:
-            k, v = part.split(":", 1)
-            out[k.strip()] = v.strip()
-    return out
 
 
 @register_filter

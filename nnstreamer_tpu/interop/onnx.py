@@ -201,15 +201,32 @@ def _lower(nodes: List[_Node], inits: Dict[str, np.ndarray],
     quant_names = {name for name, dtype, _ in g_in + g_out
                    if np.dtype(dtype) in (np.dtype(np.uint8),
                                           np.dtype(np.int8))}
+    # exporters sometimes emit scale/zero_point as Constant nodes rather
+    # than initializers — fold those in before the boundary scan
+    bound = dict(inits)
+    for node in nodes:
+        if node.op == "Constant" and node.outputs:
+            v = node.attrs.get("value")
+            if v is not None:
+                bound.setdefault(node.outputs[0], np.asarray(v))
+
+    def _qparams(node, default_zp_dtype):
+        if node.inputs[1] not in bound:
+            raise NotImplementedError(
+                f"{node.op} at a quantized graph boundary needs a "
+                f"compile-time scale; {node.inputs[1]!r} is not an "
+                "initializer or Constant")
+        scale = bound[node.inputs[1]]
+        zp = bound.get(node.inputs[2]) if len(node.inputs) > 2 \
+            and node.inputs[2] else None
+        return scale, (zp if zp is not None
+                       else np.zeros(1, default_zp_dtype))
+
     for node in nodes:
         if node.op == "DequantizeLinear" and node.inputs[0] in quant_names:
-            zp = inits.get(node.inputs[2]) if len(node.inputs) > 2 \
-                else np.zeros(1, np.int64)
-            in_q[node.inputs[0]] = (inits[node.inputs[1]], zp)
+            in_q[node.inputs[0]] = _qparams(node, np.int64)
         if node.op == "QuantizeLinear" and node.outputs[0] in quant_names:
-            zp = inits.get(node.inputs[2]) if len(node.inputs) > 2 \
-                else np.zeros(1, np.uint8)
-            out_q[node.outputs[0]] = (inits[node.inputs[1]], zp)
+            out_q[node.outputs[0]] = _qparams(node, np.uint8)
 
     def fn(*args):
         env: Dict[str, Any] = {}
@@ -450,7 +467,24 @@ def _eval_node(node: _Node, val, npval, jnp, lax) -> List[Any]:
         x = val(i[0])
         pads = a.get("pads") or [int(p) for p in npval(i[1])]
         n = len(pads) // 2
-        return [jnp.pad(x, [(pads[d], pads[d + n]) for d in range(n)])]
+        if len(i) > 3 and i[3]:  # opset-18 optional axes input
+            axes = [int(ax) % x.ndim for ax in npval(i[3])]
+            widths = [(0, 0)] * x.ndim
+            for k, ax in enumerate(axes):
+                widths[ax] = (pads[k], pads[k + n])
+        else:
+            widths = [(pads[d], pads[d + n]) for d in range(n)]
+        mode = a.get("mode", "constant")
+        if isinstance(mode, bytes):
+            mode = mode.decode()
+        if mode == "constant":
+            cval = a.get("value", 0.0)
+            if len(i) > 2 and i[2]:
+                cval = float(np.asarray(npval(i[2])).reshape(-1)[0])
+            return [jnp.pad(x, widths, constant_values=cval)]
+        if mode in ("reflect", "edge"):
+            return [jnp.pad(x, widths, mode=mode)]
+        raise NotImplementedError(f"Pad mode {mode!r} unsupported")
     if op == "BatchNormalization":
         x = val(i[0])
         scale = np.asarray(npval(i[1]), np.float32)

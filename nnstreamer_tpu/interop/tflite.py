@@ -534,7 +534,12 @@ def _eval_op(op: _Op, val, const_val, tensors, jnp, lax):
         strides = [int(s) for s in const_val(op.inputs[3])]
         bm = scalar(0, "i32")
         em = scalar(1, "i32")
+        ellipsis = scalar(2, "i32")
+        new_axis = scalar(3, "i32")
         shrink = scalar(4, "i32")
+        if ellipsis or new_axis:
+            raise NotImplementedError(
+                "STRIDED_SLICE ellipsis_mask/new_axis_mask unsupported")
         idx = []
         for d in range(len(begin)):
             if shrink & (1 << d):

@@ -95,6 +95,9 @@ class Queue(Element):
                             if not isinstance(old, Event):
                                 del self._q.queue[i]
                                 dropped = True
+                                # wake producers blocked in put(): mutex IS
+                                # the not_full condition's lock
+                                self._q.not_full.notify()
                                 break
                     if not dropped:
                         # only events queued: block until the worker drains

@@ -101,6 +101,50 @@ def test_mux_basepad():
     assert [o.pts for o in out] == [0, 300, 600]
 
 
+def test_mux_basepad_window_clamps_to_pts_delta():
+    """nnstreamer_plugin_api_impl.c:368-377: window =
+    MIN(duration, ABS(pts_delta)-1) once the base pad has history —
+    a configured duration larger than the base PTS step must not widen
+    the match window."""
+    pipe = _mux_pipeline("basepad", "0:100")
+    pipe.start()
+    a, b = pipe["a"], pipe["b"]
+    a.push_buffer(_buf(0, 10))
+    b.push_buffer(_buf(100, 10))
+    a.push_buffer(_buf(1, 30))
+    b.push_buffer(_buf(101, 55))  # |55-30|=25 > min(100, |30-10|-1=19)
+    a.push_buffer(_buf(2, 50))
+    b.push_buffer(_buf(102, 56))  # |56-50|=6 <= 19 but 101 is taken first
+    a.end_stream()
+    b.end_stream()
+    pipe.wait_eos(timeout=30)
+    pipe.stop()
+    outs = [(o.pts, [int(c.host()[0]) for c in o.chunks])
+            for o in pipe["out"].buffers]
+    assert outs[:3] == [(10, [0, 100]), (30, [1, 100]), (50, [2, 101])]
+
+
+def test_mux_collect_is_order_independent():
+    """Race regression: one pad delivering its whole stream (incl. EOS)
+    before the other pad delivers anything must not lose tuples or send
+    EOS early — collection only fires once every live pad has data."""
+    pipe = _mux_pipeline("basepad", "0:100")
+    pipe.start()
+    a, b = pipe["a"], pipe["b"]
+    for val, pts in [(0, 10), (1, 30), (2, 50)]:
+        a.push_buffer(_buf(val, pts))
+    a.end_stream()
+    time.sleep(0.3)  # let pad a fully drain into the mux first
+    for val, pts in [(100, 10), (101, 55), (102, 56)]:
+        b.push_buffer(_buf(val, pts))
+    b.end_stream()
+    pipe.wait_eos(timeout=30)
+    pipe.stop()
+    outs = [(o.pts, [int(c.host()[0]) for c in o.chunks])
+            for o in pipe["out"].buffers]
+    assert outs[:2] == [(10, [0, 100]), (30, [1, 100])]
+
+
 def test_mux_refresh():
     pipe = _mux_pipeline("refresh")
     pipe.start()

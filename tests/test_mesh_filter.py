@@ -1,0 +1,144 @@
+"""Mesh-mode JaxFilter: multi-chip invoke in the *pipeline* layer.
+
+The reference fans inference streams across devices via tensor_query
+(ref: gst/nnstreamer/tensor_query/README.md:5-27); the TPU-native design
+additionally lets one tensor_filter invoke fan out over a device mesh —
+params sharded by rule table, batch sharded over the ``data`` axis, XLA
+collectives over ICI. These tests run on the 8-virtual-device CPU mesh
+(conftest.py) exactly like the driver's dryrun.
+"""
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from nnstreamer_tpu import Buffer, parse_launch
+from nnstreamer_tpu.filters import FilterProperties, find_filter
+
+CAPS8x64 = ("other/tensors,format=static,num_tensors=1,"
+            "types=(string)float32,dimensions=(string)64:8,framerate=0/1")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _open_filter(custom=""):
+    fw = find_filter("jax")()
+    fw.open(FilterProperties(framework="jax",
+                             model_files=("zoo://mlp?dtype=float32",),
+                             custom_properties=custom))
+    return fw
+
+
+def test_mesh_invoke_matches_single_device():
+    x = np.random.RandomState(0).randn(8, 64).astype(np.float32)
+    ref = _open_filter()
+    want = np.asarray(ref.invoke([x])[0])
+    ref.close()
+
+    fw = _open_filter("mesh:4x1x2,rules:gpt")
+    out = fw.invoke([x])[0]
+    # batch rides the data axis: the invoke really fanned out over chips
+    assert len(out.sharding.device_set) == 8
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5, atol=1e-5)
+    fw.close()
+
+
+def test_mesh_invoke_indivisible_batch_replicates():
+    fw = _open_filter("mesh:4x1x2,rules:gpt")
+    x = np.random.RandomState(1).randn(3, 64).astype(np.float32)
+    out = np.asarray(fw.invoke([x])[0])
+    assert out.shape == (3, 10)
+    fw.close()
+
+
+def test_mesh_suspend_resume_keeps_sharding():
+    from nnstreamer_tpu.filters.base import FilterEvent
+    x = np.random.RandomState(2).randn(8, 64).astype(np.float32)
+    fw = _open_filter("mesh:4x1x2,rules:gpt")
+    want = np.asarray(fw.invoke([x])[0])
+    assert fw.handle_event(FilterEvent.SUSPEND)
+    got = fw.invoke([x])[0]  # transparent resume
+    assert len(got.sharding.device_set) == 8
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+    fw.close()
+
+
+def test_pipeline_mesh_filter_matches_single_device():
+    """VERDICT r2 #1 'done' criterion: a *pipeline* on the 8-device mesh
+    whose sharded invoke output equals the single-device output."""
+    x = np.random.RandomState(3).randn(8, 64).astype(np.float32)
+
+    def run(custom):
+        opt = f" custom={custom}" if custom else ""
+        p = parse_launch(
+            f'appsrc name=in caps="{CAPS8x64}" '
+            f'! tensor_filter framework=jax model=zoo://mlp?dtype=float32'
+            f'{opt} ! appsink name=out')
+        p.start()
+        p["in"].push_buffer(Buffer.from_arrays([x]))
+        p["in"].end_stream()
+        assert p.wait_eos(timeout=30)
+        p.stop()
+        return np.asarray(p["out"].buffers[-1].chunks[0].host())
+
+    single = run("")
+    meshed = run("mesh:2x1x4,rules:gpt")
+    np.testing.assert_allclose(meshed, single, rtol=1e-5, atol=1e-5)
+
+
+def test_query_fanout_to_mesh_server():
+    """BASELINE config 5 shape: multiple query clients feed one server
+    pipeline whose filter holds ONE mesh-sharded model (workers share
+    params; batch dim rides the data axis)."""
+    port = _free_port()
+    server = parse_launch(
+        f'tensor_query_serversrc name=qs port={port} id=7 '
+        '! tensor_filter framework=jax model=zoo://mlp?dtype=float32 '
+        'custom=mesh:4x1x2,rules:gpt '
+        '! tensor_query_serversink id=7')
+    server.start()
+    time.sleep(0.2)
+
+    ref = _open_filter()
+    xs = {i: np.random.RandomState(10 + i).randn(8, 64).astype(np.float32)
+          for i in range(2)}
+    want = {i: np.asarray(ref.invoke([xs[i]])[0]) for i in xs}
+    ref.close()
+
+    results = {}
+
+    def run_client(tag):
+        c = parse_launch(
+            f'appsrc name=in caps="{CAPS8x64}" '
+            f'! tensor_query_client port={port} timeout=20 '
+            '! appsink name=out')
+        c.start()
+        c["in"].push_buffer(Buffer.from_arrays([xs[tag]]))
+        deadline = time.monotonic() + 25
+        while not c["out"].buffers and time.monotonic() < deadline:
+            time.sleep(0.05)
+        results[tag] = [np.asarray(b.chunks[0].host()).copy()
+                        for b in c["out"].buffers]
+        c["in"].end_stream()
+        c.stop()
+
+    threads = [threading.Thread(target=run_client, args=(i,)) for i in xs]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=40)
+    server.stop()
+    for i in xs:
+        assert len(results[i]) == 1, f"client {i} got {results[i]}"
+        np.testing.assert_allclose(results[i][0], want[i],
+                                   rtol=1e-4, atol=1e-4)
