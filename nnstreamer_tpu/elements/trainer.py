@@ -37,6 +37,8 @@ class TensorTrainer(TransformElement):
         "epochs": 1,
         "num-inputs": 1,
         "num-labels": 1,
+        "mesh": "",   # "DxSxT"/"auto": shard the train step over a mesh
+        "rules": "",  # param-sharding rule table (e.g. "gpt")
     }
 
     def __init__(self, name=None, **props):
@@ -56,7 +58,9 @@ class TensorTrainer(TransformElement):
                 num_labels=self.num_labels,
                 num_training_samples=self.num_training_samples,
                 num_validation_samples=self.num_validation_samples,
-                epochs=self.epochs))
+                epochs=self.epochs,
+                mesh=self.mesh,
+                rules=self.rules))
             self.fw.set_event_notifier(self._on_trainer_event)
             self.fw.start()
 

@@ -58,30 +58,6 @@ def _device_for(accelerators: Sequence[Accelerator]):
     return jax.devices()[0]
 
 
-def _build_mesh(spec: str):
-    """``2x2x2`` -> Mesh(dp=2, sp=2, tp=2); ``auto`` factors all devices."""
-    from ..parallel import mesh as meshlib
-    if spec in ("auto", "true"):
-        return meshlib.best_mesh()
-    dims = [int(d) for d in spec.lower().split("x")]
-    while len(dims) < 3:
-        dims.append(1)
-    return meshlib.make_mesh(tuple(dims[:3]))
-
-
-_RULE_TABLES: Dict[str, Any] = {}
-
-
-def _rules_for(name: str):
-    if not _RULE_TABLES:
-        from ..parallel import sharding as sh
-        _RULE_TABLES.update({"gpt": sh.GPT_RULES, "none": [], "": []})
-    if name not in _RULE_TABLES:
-        raise ValueError(f"unknown sharding rule table {name!r} "
-                         f"(have: {sorted(_RULE_TABLES)})")
-    return _RULE_TABLES[name]
-
-
 @register_filter
 class JaxFilter(FilterFramework):
     """framework=jax (aliases: jax-tpu). The flagship backend."""
@@ -111,9 +87,10 @@ class JaxFilter(FilterFramework):
         model = props.model_files[0] if props.model_files else ""
         self._load_model(model, props)
         if "mesh" in opts:
-            from ..parallel.sharding import named_sharding_tree
-            self._mesh = _build_mesh(opts["mesh"])
-            rules = _rules_for(opts.get("rules", ""))
+            from ..parallel.mesh import mesh_from_spec
+            from ..parallel.sharding import named_sharding_tree, rules_by_name
+            self._mesh = mesh_from_spec(opts["mesh"])
+            rules = rules_by_name(opts.get("rules", ""))
             self._param_sharding = named_sharding_tree(
                 self._params, rules, self._mesh)
             if self._params is not None:

@@ -34,6 +34,18 @@ def make_mesh(shape: Sequence[int], axis_names: Sequence[str] = AXES,
     return Mesh(arr, tuple(axis_names))
 
 
+def mesh_from_spec(spec: str) -> Mesh:
+    """Element-property mesh grammar: ``"2x2x2"`` -> Mesh(dp=2, sp=2,
+    tp=2); missing trailing factors default to 1; ``"auto"``/``"true"``
+    factors all visible devices via :func:`best_mesh`."""
+    if spec in ("auto", "true"):
+        return best_mesh()
+    dims = [int(d) for d in spec.lower().split("x")]
+    while len(dims) < 3:
+        dims.append(1)
+    return make_mesh(tuple(dims[:3]))
+
+
 def best_mesh(n_devices: Optional[int] = None, model_parallel: int = 0,
               seq_parallel: int = 0) -> Mesh:
     """Factor n into (data, seq, model).

@@ -28,6 +28,15 @@ GPT_RULES: List[Rule] = [
 ]
 
 
+def rules_by_name(name: str) -> List[Rule]:
+    """Named rule tables for element properties (``rules:gpt``)."""
+    tables = {"gpt": GPT_RULES, "none": [], "": []}
+    if name not in tables:
+        raise ValueError(f"unknown sharding rule table {name!r} "
+                         f"(have: {sorted(k for k in tables if k)})")
+    return tables[name]
+
+
 def _path_str(path) -> str:
     parts = []
     for p in path:

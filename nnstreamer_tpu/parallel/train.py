@@ -51,17 +51,24 @@ def create_train_state(params: Any, optimizer: optax.GradientTransformation,
 
 def make_train_step(loss_fn: Callable[[Any, Any], jax.Array],
                     optimizer: optax.GradientTransformation,
-                    donate: bool = True) -> Callable[[TrainState, Any],
-                                                     Tuple[TrainState, jax.Array]]:
-    """loss_fn(params, batch) -> scalar. Returns jitted (state, batch) ->
-    (state, loss). Sharding flows from the input arrays."""
+                    donate: bool = True,
+                    has_aux: bool = False) -> Callable[[TrainState, Any],
+                                                       Tuple]:
+    """loss_fn(params, batch) -> scalar (or (scalar, aux) with has_aux).
+    Returns jitted (state, batch) -> (state, loss[, aux]). Sharding flows
+    from the input arrays."""
 
-    def step(state: TrainState, batch) -> Tuple[TrainState, jax.Array]:
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+    def step(state: TrainState, batch) -> Tuple:
+        if has_aux:
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state.params, batch)
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
         updates, opt_state = optimizer.update(grads, state.opt_state,
                                               state.params)
         params = optax.apply_updates(state.params, updates)
-        return TrainState(params, opt_state, state.step + 1), loss
+        new = TrainState(params, opt_state, state.step + 1)
+        return (new, loss, aux) if has_aux else (new, loss)
 
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 

@@ -31,6 +31,11 @@ class TrainerProperties:
     num_training_samples: int = 0
     num_validation_samples: int = 0
     epochs: int = 1
+    # multi-chip: "DxSxT" / "auto" device mesh + sharding rule table name
+    # (this framework's extension — the reference delegates device
+    # placement to the NNTrainer subplugin)
+    mesh: str = ""
+    rules: str = ""
 
 
 @dataclasses.dataclass

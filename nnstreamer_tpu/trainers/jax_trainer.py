@@ -17,8 +17,12 @@ the trainer consumes num-training-samples every epoch). If the stream
 ends early the last complete dataset is reused for remaining epochs,
 and once training finishes further pushed samples are discarded so EOS
 can propagate.
-Checkpoints go through orbax (trainers/checkpoint.py); on a mesh the
-train step is the sharded one from parallel/train.py.
+Checkpoints go through orbax (trainers/checkpoint.py). With the ``mesh``
+property set (``tensor_trainer mesh=4x1x2 rules=gpt``) the loop really
+uses parallel/train.py: params+optimizer moments placed by the rule
+table via create_train_state, the batch sharded over the ``data`` axis
+via shard_batch, and make_train_step's jit letting GSPMD insert the
+gradient psum/reduce-scatter collectives over ICI.
 """
 from __future__ import annotations
 
@@ -172,16 +176,47 @@ class JaxTrainer(TrainerFramework):
             return arrays[:n_in], arrays[n_in:]
 
         opt = self._optimizer
-        opt_state = jax.jit(opt.init)(self.params)
+        mesh = None
+        if p.mesh:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from ..parallel import train as ptrain
+            from ..parallel.mesh import mesh_from_spec
+            from ..parallel.sharding import rules_by_name
+            mesh = mesh_from_spec(p.mesh)
+            rules = rules_by_name(p.rules or "")
+            state = ptrain.create_train_state(self.params, opt, mesh, rules)
+            self.params = state.params
+            ndp = mesh.shape.get("data", 1)
 
-        @jax.jit
-        def step(params, opt_state, inputs, labels):
-            (loss, acc), grads = jax.value_and_grad(
-                self._loss_fn, has_aux=True)(params, inputs, labels)
-            updates, opt_state = opt.update(grads, opt_state, params)
-            import optax
-            params = optax.apply_updates(params, updates)
-            return params, opt_state, loss, acc
+            def loss_on_batch(params, batch):
+                return self._loss_fn(params, batch[0], batch[1])
+
+            sharded_step = ptrain.make_train_step(loss_on_batch, opt,
+                                                  has_aux=True)
+
+            def shard(batch):
+                n = batch[0][0].shape[0]
+                spec = P("data") if ndp > 1 and n % ndp == 0 else P()
+                return jax.device_put(batch, NamedSharding(mesh, spec))
+
+            def step(params, opt_state, inputs, labels):
+                nonlocal state
+                state, loss, acc = sharded_step(state,
+                                                shard((inputs, labels)))
+                return state.params, state.opt_state, loss, acc
+
+            opt_state = state.opt_state
+        else:
+            opt_state = jax.jit(opt.init)(self.params)
+
+            @jax.jit
+            def step(params, opt_state, inputs, labels):
+                (loss, acc), grads = jax.value_and_grad(
+                    self._loss_fn, has_aux=True)(params, inputs, labels)
+                updates, opt_state = opt.update(grads, opt_state, params)
+                import optax
+                params = optax.apply_updates(params, updates)
+                return params, opt_state, loss, acc
 
         @jax.jit
         def evaluate(params, inputs, labels):
