@@ -15,7 +15,8 @@ import collections
 import time
 from typing import Any, List, Optional
 
-from ..filters.base import Accelerator, FilterEvent, FilterProperties
+from ..filters.base import (Accelerator, FilterEvent, FilterProperties,
+                            InvokeDrop)
 from ..filters.registry import (detect_framework, find_filter,
                                 shared_model_get, shared_model_insert,
                                 shared_model_release)
@@ -24,6 +25,7 @@ from ..tensors.caps import Caps
 from ..tensors.info import TensorInfo, TensorsConfig, TensorsInfo
 from ..tensors.types import TensorFormat
 from ..pipeline.element import Element
+from ..pipeline.events import Event, QosEvent
 from ..pipeline.pad import Pad
 from ..pipeline.registry import register_element
 from ..utils.log import logger
@@ -32,6 +34,11 @@ from ..utils.watchdog import Watchdog
 # rolling window for the latency property
 # (≙ GST_TF_STAT_MAX_RECENT, tensor_filter.c)
 _MAX_RECENT = 10
+
+# latency re-report thresholds (≙ tensor_filter.c:106-118): re-post when
+# the estimate grows past reported×(1+5%) or improves by more than 25%
+_LATENCY_REPORT_HEADROOM = 1.05
+_LATENCY_IMPROVE_THRESHOLD = 0.75
 
 
 @register_element("tensor_filter")
@@ -69,6 +76,11 @@ class TensorFilter(Element):
         self._in_combi: Optional[List[int]] = None
         self._out_combi: Optional[List[str]] = None
         self._batch: Optional[int] = None  # batched-invoke leading dim
+        self._reported_latency_us: Optional[float] = None
+        self._throttle_period_ns = 0       # from downstream QoS events
+        self._next_accept_ts: Optional[int] = None
+        self.stats.update({"invoke_errors": 0, "frames_dropped": 0,
+                           "qos_dropped": 0})
 
     # -- framework lifecycle ---------------------------------------------
     def _open_fw(self) -> None:
@@ -200,21 +212,82 @@ class TensorFilter(Element):
 
     # -- hot path ---------------------------------------------------------
     def do_chain(self, pad: Pad, buf: Buffer) -> None:
+        if self._qos_should_drop(buf):
+            # downstream can't keep up: skip the invoke entirely so the
+            # accelerator does no wasted work (≙ throttling check,
+            # tensor_filter.c:532-584)
+            self.stats["qos_dropped"] += 1
+            return
         inputs = [c.raw for c in buf.chunks]
         if self._in_combi:
             inputs = [inputs[i] for i in self._in_combi]
         t0 = time.perf_counter_ns()
-        if self.invoke_async:
-            self._async_template = buf
-            self.fw.invoke_async(inputs)
-            self._record_latency(time.perf_counter_ns() - t0)
+        try:
+            if self.invoke_async:
+                self._async_template = buf
+                self.fw.invoke_async(inputs)
+                self._record_latency(time.perf_counter_ns() - t0)
+                return
+            outputs = self.fw.invoke(inputs)
+        except InvokeDrop:
+            # subplugin-signaled drop (≙ invoke result > 0): silent
+            self.stats["frames_dropped"] += 1
             return
-        outputs = self.fw.invoke(inputs)
+        except Exception as exc:  # noqa: BLE001
+            # invoke failure drops THIS frame but keeps the pipeline alive
+            # (≙ tensor_filter.c:961-963); the error is surfaced on the
+            # bus as a warning with an error counter, not a fatal error.
+            # Warnings are rate-limited (1, 2, 4, 8, ... then every 64th)
+            # so a permanently broken model can't flood an unread bus, and
+            # carry the message string only — holding the exception object
+            # would pin the traceback (and the input tensors) in memory.
+            n = self.stats["invoke_errors"] = self.stats["invoke_errors"] + 1
+            self.stats["frames_dropped"] += 1
+            logger.warning("%s: invoke failed (frame dropped, pipeline "
+                           "kept): %s", self.name, exc)
+            if n & (n - 1) == 0 or n % 64 == 0:
+                self.post_message("warning", error=str(exc),
+                                  invoke_errors=n,
+                                  remedy="check the model's input "
+                                         "dims/dtypes against the "
+                                         "negotiated caps, or the "
+                                         "subplugin's own logs")
+            return
         self._record_latency(time.perf_counter_ns() - t0)
         if self._watchdog is not None:
             self._watchdog.feed()
         out_chunks = self._combine_outputs(buf, outputs)
         self.push(buf.with_chunks(out_chunks))
+
+    # -- QoS throttling ----------------------------------------------------
+    def handle_event(self, pad: Pad, event: Event) -> None:
+        from ..pipeline.events import FlushEvent, SegmentEvent
+        if isinstance(event, (SegmentEvent, FlushEvent)):
+            # new segment / flush = PTS discontinuity: stale throttle state
+            # would otherwise qos-drop every post-restart frame forever
+            self._throttle_period_ns = 0
+            self._next_accept_ts = None
+        super().handle_event(pad, event)
+
+    def _qos_should_drop(self, buf: Buffer) -> bool:
+        if self._throttle_period_ns <= 0 or buf.pts is None:
+            return False
+        if self._next_accept_ts is not None and buf.pts < self._next_accept_ts:
+            return True
+        self._next_accept_ts = buf.pts + self._throttle_period_ns
+        return False
+
+    def handle_upstream_event(self, pad: Pad, event: Event) -> None:
+        if isinstance(event, QosEvent):
+            # keep the larger of the downstream-requested spacing and our
+            # own invoke latency (we can never go faster than the model)
+            lat_ns = int(self.latency_average_us() * 1e3)
+            self._throttle_period_ns = max(event.period_ns, lat_ns) \
+                if event.proportion > 1.0 else 0
+            if self._throttle_period_ns == 0:
+                self._next_accept_ts = None
+            return  # consumed: the filter is the throttling point
+        super().handle_upstream_event(pad, event)
 
     def _combine_outputs(self, inbuf: Buffer, outputs: List[Any]) -> List[Chunk]:
         if not self._out_combi:
@@ -242,6 +315,17 @@ class TensorFilter(Element):
         self._recent_latency.append(dt_ns)
         if self.latency:
             self.latency_us = self.latency_average_us()
+            self._maybe_report_latency(self.latency_us)
+
+    def _maybe_report_latency(self, est_us: float) -> None:
+        """Post a LATENCY bus message when the rolling estimate drifts
+        past the 5% headroom or improves by more than 25%
+        (≙ tensor_filter.c:490-527 re-reporting thresholds)."""
+        rep = self._reported_latency_us
+        if rep is None or est_us > rep * _LATENCY_REPORT_HEADROOM \
+                or est_us < rep * _LATENCY_IMPROVE_THRESHOLD:
+            self._reported_latency_us = est_us
+            self.post_message("latency", latency_us=est_us)
 
     def latency_average_us(self) -> float:
         """Rolling average over the last 10 invokes, µs

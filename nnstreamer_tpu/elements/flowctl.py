@@ -12,7 +12,7 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from ..pipeline.element import Element, TransformElement
-from ..pipeline.events import EosEvent
+from ..pipeline.events import EosEvent, QosEvent
 from ..pipeline.pad import Pad, PadDirection
 from ..pipeline.registry import register_element
 from ..tensors.buffer import Buffer
@@ -158,6 +158,8 @@ class TensorRate(TransformElement):
         super().__init__(name, **props)
         self._next_ts: Optional[int] = None
         self._prev: Optional[Buffer] = None
+        self._throttling = False
+        self._last_in_pts: Optional[int] = None
         self.stats.update({"in": 0, "out": 0, "dup": 0, "drop": 0})
 
     def _target(self):
@@ -183,10 +185,31 @@ class TensorRate(TransformElement):
         period = int(1e9 * tgt[1] / tgt[0])
         if self._next_ts is None:
             self._next_ts = buf.pts
+        in_delta = (buf.pts - self._last_in_pts
+                    if self._last_in_pts is not None else None)
+        self._last_in_pts = buf.pts
         if buf.pts < self._next_ts:
             self.stats["drop"] += 1
             self._prev = buf
+            if self.throttle and not self._throttling:
+                # upstream is overproducing: ask producers (tensor_filter
+                # consumes this) to space frames at our target period so
+                # the dropped frames are never computed (≙ the QoS events
+                # gsttensor_rate.c emits when throttle=true). Proportion =
+                # target period / observed inter-arrival spacing (> 1 when
+                # frames arrive faster than we can emit them); one event
+                # per throttle episode, not per drop.
+                self._throttling = True
+                prop = (period / in_delta) if in_delta and in_delta > 0 else 2.0
+                self.send_upstream_event(QosEvent(
+                    proportion=max(prop, 1.01),
+                    period_ns=period, timestamp=buf.pts))
             return None
+        if self._throttling and self.throttle:
+            # back under budget: clear the throttle
+            self._throttling = False
+            self.send_upstream_event(QosEvent(proportion=1.0, period_ns=0,
+                                              timestamp=buf.pts))
         # duplicate previous frame into any gap
         while self._prev is not None and buf.pts >= self._next_ts + period:
             dup = self._prev.with_chunks(self._prev.chunks)

@@ -2,7 +2,7 @@
 from . import (custom, custom_c, jax_backend, llm,  # noqa: F401
                onnx_backend, tflite_backend)  # (register built-in backends)
 from .base import (Accelerator, FilterEvent, FilterFramework,
-                   FilterProperties)
+                   FilterProperties, InvokeDrop)
 from .custom import register_custom_easy, unregister_custom_easy
 from .registry import (all_filters, detect_framework, find_filter,
                        register_alias, register_filter, shared_model_get,
@@ -11,6 +11,7 @@ from .registry import (all_filters, detect_framework, find_filter,
 
 __all__ = [
     "FilterFramework", "FilterProperties", "FilterEvent", "Accelerator",
+    "InvokeDrop",
     "register_filter", "register_alias", "find_filter", "all_filters",
     "detect_framework", "register_custom_easy", "unregister_custom_easy",
     "shared_model_get", "shared_model_insert", "shared_model_release",

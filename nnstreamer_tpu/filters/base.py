@@ -32,6 +32,13 @@ def parse_custom_properties(s: str) -> Dict[str, str]:
     return out
 
 
+class InvokeDrop(Exception):
+    """Raised by a backend's ``invoke`` to signal "drop this frame, keep
+    the pipeline" (≙ invoke result > 0, tensor_filter.c:961-963). Any
+    other exception from invoke is counted as an invoke *error*; both
+    drop the frame rather than killing the pipeline."""
+
+
 class FilterEvent(enum.Enum):
     """(ref: event_ops enum, nnstreamer_plugin_api_filter.h:205-217)"""
 

@@ -203,6 +203,20 @@ class Element:
             if p.is_linked:
                 p.push(event)
 
+    # -- upstream events ---------------------------------------------------
+    def send_upstream_event(self, event: Event) -> None:
+        """Send an out-of-band event upstream (≙ gst_pad_push_event on a
+        sink pad — the QoS path). Travels sink-pad → upstream element's
+        ``handle_upstream_event`` directly, bypassing queues, like
+        GStreamer's non-serialized upstream events."""
+        for p in self.sink_pads.values():
+            if p.is_linked:
+                p.peer.element.handle_upstream_event(p.peer, event)
+
+    def handle_upstream_event(self, pad: Pad, event: Event) -> None:
+        """Default: keep propagating toward the source."""
+        self.send_upstream_event(event)
+
     # -- push helpers -----------------------------------------------------
     def push(self, buf: Buffer, pad: Optional[Pad] = None) -> None:
         (pad or self.srcpad).push(buf)

@@ -53,4 +53,20 @@ class CustomEvent(Event):
     data: Dict[str, Any] = field(default_factory=dict)
 
 
+@dataclass
+class QosEvent(Event):
+    """Upstream QoS feedback (≙ GST_EVENT_QOS as consumed by the
+    reference's tensor_filter throttling, tensor_filter.c:532-584).
+
+    ``proportion`` > 1 means downstream is falling behind (it received
+    frames faster than it can emit them); ``period_ns`` is the minimum
+    inter-frame spacing downstream can sustain (the throttling delay).
+    Travels upstream, out-of-band (not through queues).
+    """
+
+    proportion: float = 1.0
+    period_ns: int = 0
+    timestamp: Optional[int] = None
+
+
 EOS = EosEvent

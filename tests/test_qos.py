@@ -1,0 +1,131 @@
+"""QoS / error-resilience semantics of tensor_filter (+ tensor_rate).
+
+Scope ≙ reference tensor_filter.c:961-963 (invoke result > 0 = drop frame,
+keep pipeline), :490-527 (LATENCY drift re-reporting, 5%/25% thresholds)
+and :532-584 (throttling on downstream QoS); gsttensor_rate.c throttle.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nt
+from nnstreamer_tpu.filters import InvokeDrop, register_custom_easy
+from nnstreamer_tpu.tensors import TensorsInfo
+
+CAPS_F32 = ("other/tensors,format=static,num_tensors=1,types=float32,"
+            "dimensions=8,framerate=0/1")
+CAPS_30FPS = CAPS_F32.replace("framerate=0/1", "framerate=30/1")
+
+
+def _info():
+    return TensorsInfo.make("float32", "8")
+
+
+class TestInvokeErrorSemantics:
+    def test_failing_every_nth_drops_frame_keeps_pipeline(self):
+        calls = [0]
+
+        def flaky(x):
+            calls[0] += 1
+            if calls[0] % 3 == 0:
+                raise RuntimeError("injected invoke failure")
+            return x
+
+        register_custom_easy("flaky3", flaky, _info(), _info())
+        p = nt.parse_launch(
+            f"tensortestsrc caps={CAPS_F32} num-buffers=9 ! "
+            "tensor_filter name=f framework=custom-easy model=flaky3 ! "
+            "appsink name=out")
+        p.run(15)
+        # every 3rd invoke failed -> 6 of 9 frames delivered, EOS reached
+        assert len(p["out"].buffers) == 6
+        assert p["f"].stats["invoke_errors"] == 3
+        assert p["f"].stats["frames_dropped"] == 3
+        kinds = [m.kind for m in p.bus.drain()]
+        # warnings are rate-limited (posted at errors 1, 2, 4, ...)
+        assert 1 <= kinds.count("warning") <= 3
+        assert "error" not in kinds
+
+    def test_invoke_drop_signal_is_silent(self):
+        calls = [0]
+
+        def dropper(x):
+            calls[0] += 1
+            if calls[0] % 2 == 0:
+                raise InvokeDrop()
+            return x
+
+        register_custom_easy("drop2", dropper, _info(), _info())
+        p = nt.parse_launch(
+            f"tensortestsrc caps={CAPS_F32} num-buffers=8 ! "
+            "tensor_filter name=f framework=custom-easy model=drop2 ! "
+            "appsink name=out")
+        p.run(15)
+        assert len(p["out"].buffers) == 4
+        assert p["f"].stats["frames_dropped"] == 4
+        assert p["f"].stats["invoke_errors"] == 0
+        assert not [m for m in p.bus.drain() if m.kind == "warning"]
+
+
+class TestLatencyDrift:
+    def test_latency_messages_posted_on_drift(self):
+        state = {"n": 0}
+
+        def slowing(x):
+            state["n"] += 1
+            # first invokes fast, later ones 10x slower -> drift > 5%
+            time.sleep(0.0005 if state["n"] <= 10 else 0.01)
+            return x
+
+        register_custom_easy("slowing", slowing, _info(), _info())
+        p = nt.parse_launch(
+            f"tensortestsrc caps={CAPS_F32} num-buffers=16 ! "
+            "tensor_filter name=f framework=custom-easy model=slowing "
+            "latency=1 ! fakesink")
+        p.run(30)
+        lat = [m for m in p.bus.drain() if m.kind == "latency"]
+        assert len(lat) >= 2  # initial report + at least one drift re-report
+        assert lat[-1].data["latency_us"] > lat[0].data["latency_us"] * 1.05
+
+    def test_no_latency_messages_when_disabled(self):
+        register_custom_easy("idle", lambda x: x, _info(), _info())
+        p = nt.parse_launch(
+            f"tensortestsrc caps={CAPS_F32} num-buffers=4 ! "
+            "tensor_filter framework=custom-easy model=idle ! fakesink")
+        p.run(15)
+        assert not [m for m in p.bus.drain() if m.kind == "latency"]
+
+
+class TestQosThrottling:
+    def test_rate_throttle_skips_upstream_invokes(self):
+        calls = [0]
+
+        def counting(x):
+            calls[0] += 1
+            return x
+
+        register_custom_easy("counting", counting, _info(), _info())
+        # 30 fps source into a 10 fps tensor_rate: without QoS the filter
+        # would invoke 30 times; with throttle=true the rate element's QoS
+        # event makes the filter skip frames pre-invoke
+        p = nt.parse_launch(
+            f"tensortestsrc caps={CAPS_30FPS} num-buffers=30 ! "
+            "tensor_filter name=f framework=custom-easy model=counting ! "
+            "tensor_rate name=r framerate=10/1 throttle=true ! "
+            "appsink name=out")
+        p.run(20)
+        assert p["f"].stats["qos_dropped"] > 0
+        assert calls[0] + p["f"].stats["qos_dropped"] == 30
+        assert calls[0] < 30
+        # rate still emits its nominal cadence from what it receives
+        assert p["r"].stats["out"] == len(p["out"].buffers)
+
+    def test_throttle_off_means_no_qos_drop(self):
+        register_custom_easy("idle2", lambda x: x, _info(), _info())
+        p = nt.parse_launch(
+            f"tensortestsrc caps={CAPS_30FPS} num-buffers=15 ! "
+            "tensor_filter name=f framework=custom-easy model=idle2 ! "
+            "tensor_rate framerate=10/1 throttle=false ! fakesink")
+        p.run(20)
+        assert p["f"].stats["qos_dropped"] == 0
