@@ -168,6 +168,18 @@ class TensorRate(TransformElement):
         n, _, d = self.framerate.partition("/")
         return int(n), int(d or 1)
 
+    def handle_event(self, pad, event) -> None:
+        from ..pipeline.events import FlushEvent, SegmentEvent
+        if isinstance(event, (SegmentEvent, FlushEvent)):
+            # PTS discontinuity: mirror tensor_filter's reset — stale
+            # _next_ts would drop every post-restart frame and a stuck
+            # _throttling flag would suppress all future QoS events
+            self._next_ts = None
+            self._prev = None
+            self._last_in_pts = None
+            self._throttling = False
+        super().handle_event(pad, event)
+
     def transform_caps(self, incaps: Caps) -> Optional[Caps]:
         tgt = self._target()
         if tgt is None:

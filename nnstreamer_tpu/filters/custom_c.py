@@ -90,7 +90,10 @@ class CustomCFilter(FilterFramework):
         self._out_info: Optional[TensorsInfo] = None
 
     def open(self, props: FilterProperties) -> None:
-        path = props.model_files[0]
+        from ..utils.conf import conf
+        # bare names resolve through the configured customfilters search
+        # dirs (≙ [filter] customfilters / NNSTREAMER_CUSTOMFILTERS)
+        path = conf.resolve_custom_filter(props.model_files[0])
         self._dll = ctypes.CDLL(path)
         get = self._dll.nns_custom_get
         get.restype = ctypes.POINTER(_CustomFilterStruct)

@@ -17,17 +17,13 @@ import os
 import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple, Type
 
+from ..utils.conf import conf
 from ..utils.log import logger
 from .base import FilterFramework
 
 _FRAMEWORKS: Dict[str, Type[FilterFramework]] = {}
 _ALIASES: Dict[str, str] = {}
 _LOCK = threading.Lock()
-
-# Detection priority when multiple backends claim an extension
-# (≙ filter-framework-priority in nnstreamer.ini.in:12-19).
-_PRIORITY = ["jax", "flax", "custom-easy", "python3", "tensorflow-lite",
-             "onnxruntime"]
 
 
 def register_filter(cls: Type[FilterFramework]) -> Type[FilterFramework]:
@@ -42,7 +38,8 @@ def register_alias(alias: str, target: str) -> None:
 
 
 def find_filter(name: str) -> Type[FilterFramework]:
-    name = _ALIASES.get(name, name)
+    # runtime-registered aliases win over configured ([filter-aliases]) ones
+    name = _ALIASES.get(name) or conf.filter_aliases().get(name, name)
     with _LOCK:
         if name not in _FRAMEWORKS:
             raise ValueError(
@@ -71,8 +68,12 @@ def detect_framework(model_files: Tuple[str, ...]) -> str:
             if ext in cls.EXTENSIONS and cls.AVAILABLE]
     if not candidates:
         raise ValueError(f"no framework claims model extension {ext!r}")
-    candidates.sort(key=lambda kv: _PRIORITY.index(kv[0])
-                    if kv[0] in _PRIORITY else len(_PRIORITY))
+    # priority from the config tiers: per-extension ini/env key, then the
+    # global list, then built-in defaults (≙ framework_priority_tflite
+    # etc., nnstreamer_conf.c / nnstreamer.ini.in:12-19)
+    priority = conf.framework_priority(ext)
+    candidates.sort(key=lambda kv: priority.index(kv[0])
+                    if kv[0] in priority else len(priority))
     name = candidates[0][0]
     logger.info("auto-detected framework %s for %s", name, model_files[0])
     return name

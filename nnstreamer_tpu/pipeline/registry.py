@@ -21,7 +21,18 @@ def register_element(name: str):
     return deco
 
 
+# infrastructure elements the parser creates implicitly (inline caps
+# tokens); restriction covers user-named elements, not these — like the
+# reference, whose allowlist governs nnstreamer elements, not gst core
+_IMPLICIT = frozenset({"capsfilter"})
+
+
 def make_element(kind: str, name=None, **props):
+    from ..utils.conf import conf
+    if kind not in _IMPLICIT and not conf.element_allowed(kind):
+        # product element allowlisting (≙ enable_element_restriction,
+        # meson_options.txt:52-53)
+        raise ValueError(f"element {kind!r} is restricted by configuration")
     try:
         cls = _ELEMENTS[kind]
     except KeyError:

@@ -1,0 +1,138 @@
+"""Config-system tests (≙ reference nnstreamer_conf.c behavior:
+ini + env tiers, framework priority, aliases, element restriction)."""
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nt
+from nnstreamer_tpu.filters import (FilterFramework, detect_framework,
+                                    find_filter, register_filter)
+from nnstreamer_tpu.pipeline.registry import make_element
+from nnstreamer_tpu.utils.conf import Conf, conf
+
+
+_CONF_VARS = ("NNS_TPU_CONF", "NNS_TPU_FRAMEWORK_PRIORITY",
+              "NNS_TPU_FRAMEWORK_PRIORITY_FAKE", "NNS_TPU_FILTER_ALIASES",
+              "NNS_TPU_RESTRICTED_ELEMENTS", "NNS_TPU_CUSTOMFILTERS")
+
+
+@pytest.fixture(autouse=True)
+def _restore_conf(monkeypatch):
+    # each test mutates env then reloads; the teardown must clear the env
+    # BEFORE reloading (fixture finalizers run before monkeypatch's own
+    # restore), or the singleton re-snapshots the dirty environment
+    import os
+    for var in _CONF_VARS:
+        monkeypatch.delenv(var, raising=False)
+    yield
+    for var in _CONF_VARS:
+        os.environ.pop(var, None)
+    conf.reload()
+
+
+@register_filter
+class _FakeA(FilterFramework):
+    NAME = "fake-a"
+    EXTENSIONS = (".fake",)
+
+    def open(self, props):
+        pass
+
+    def invoke(self, inputs):
+        return list(inputs)
+
+
+@register_filter
+class _FakeB(FilterFramework):
+    NAME = "fake-b"
+    EXTENSIONS = (".fake",)
+
+    def open(self, props):
+        pass
+
+    def invoke(self, inputs):
+        return list(inputs)
+
+
+class TestPriority:
+    def test_env_overrides_detection_priority(self, monkeypatch):
+        monkeypatch.setenv("NNS_TPU_FRAMEWORK_PRIORITY", "fake-b,fake-a")
+        conf.reload()
+        assert detect_framework(("model.fake",)) == "fake-b"
+        monkeypatch.setenv("NNS_TPU_FRAMEWORK_PRIORITY", "fake-a,fake-b")
+        conf.reload()
+        assert detect_framework(("model.fake",)) == "fake-a"
+
+    def test_per_extension_priority_wins(self, monkeypatch):
+        monkeypatch.setenv("NNS_TPU_FRAMEWORK_PRIORITY", "fake-a,fake-b")
+        monkeypatch.setenv("NNS_TPU_FRAMEWORK_PRIORITY_FAKE", "fake-b")
+        conf.reload()
+        assert detect_framework(("model.fake",)) == "fake-b"
+
+    def test_ini_priority(self, tmp_path, monkeypatch):
+        ini = tmp_path / "nns.ini"
+        ini.write_text("[filter]\nframework_priority_fake=fake-b,fake-a\n")
+        monkeypatch.setenv("NNS_TPU_CONF", str(ini))
+        conf.reload()
+        assert conf.conffile == str(ini)
+        assert detect_framework(("model.fake",)) == "fake-b"
+
+    def test_enable_envvar_false_blocks_env(self, tmp_path, monkeypatch):
+        ini = tmp_path / "nns.ini"
+        ini.write_text("[common]\nenable_envvar=False\n"
+                       "[filter]\nframework_priority_fake=fake-a\n")
+        monkeypatch.setenv("NNS_TPU_CONF", str(ini))
+        monkeypatch.setenv("NNS_TPU_FRAMEWORK_PRIORITY_FAKE", "fake-b")
+        conf.reload()
+        assert detect_framework(("model.fake",)) == "fake-a"
+
+
+class TestAliases:
+    def test_ini_alias(self, tmp_path, monkeypatch):
+        ini = tmp_path / "nns.ini"
+        ini.write_text("[filter-aliases]\nmyjax=jax\n")
+        monkeypatch.setenv("NNS_TPU_CONF", str(ini))
+        conf.reload()
+        assert find_filter("myjax").NAME == "jax"
+
+    def test_env_alias(self, monkeypatch):
+        monkeypatch.setenv("NNS_TPU_FILTER_ALIASES", "fastpath=fake-a")
+        conf.reload()
+        assert find_filter("fastpath").NAME == "fake-a"
+
+
+class TestElementRestriction:
+    def test_allowlist_blocks_unlisted(self, monkeypatch):
+        monkeypatch.setenv("NNS_TPU_RESTRICTED_ELEMENTS",
+                           "tensortestsrc,fakesink")
+        conf.reload()
+        make_element("tensortestsrc")  # listed: ok
+        with pytest.raises(ValueError, match="restricted"):
+            make_element("tensor_filter")
+
+    def test_ini_restriction(self, tmp_path, monkeypatch):
+        ini = tmp_path / "nns.ini"
+        ini.write_text("[elements]\nenable_element_restriction=True\n"
+                       "restricted_elements=fakesink\n")
+        monkeypatch.setenv("NNS_TPU_CONF", str(ini))
+        conf.reload()
+        make_element("fakesink")
+        with pytest.raises(ValueError, match="restricted"):
+            make_element("tensortestsrc")
+
+    def test_no_restriction_by_default(self):
+        conf.reload()
+        make_element("tensor_filter")
+
+
+class TestCustomFilterPaths:
+    def test_bare_name_resolves_via_search_dir(self, tmp_path, monkeypatch):
+        so = tmp_path / "myfilter.so"
+        so.write_bytes(b"\x7fELF-fake")
+        monkeypatch.setenv("NNS_TPU_CUSTOMFILTERS", str(tmp_path))
+        conf.reload()
+        assert conf.resolve_custom_filter("myfilter") == str(so)
+        assert conf.resolve_custom_filter("myfilter.so") == str(so)
+        # absolute existing path passes through untouched
+        assert conf.resolve_custom_filter(str(so)) == str(so)
+        # unknown names pass through for the loader to error on
+        assert conf.resolve_custom_filter("nope") == "nope"
