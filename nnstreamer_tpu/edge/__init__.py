@@ -5,6 +5,7 @@ tensor_query_* and edgesrc/edgesink in the reference (SURVEY.md §2.4).
 Here the control+data plane is a length-prefixed TCP protocol (DCN-side);
 in-pod scale-out instead uses jax.sharding over ICI (parallel/).
 """
+from .broker import DiscoveryBroker, discover
 from .protocol import MsgKind, recv_msg, send_msg
 
-__all__ = ["MsgKind", "send_msg", "recv_msg"]
+__all__ = ["MsgKind", "send_msg", "recv_msg", "DiscoveryBroker", "discover"]

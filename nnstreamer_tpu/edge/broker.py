@@ -1,0 +1,126 @@
+"""Discovery broker: the MQTT-hybrid control plane slot.
+
+≙ the reference's hybrid connect-type, where servers publish their
+host:port under a topic to an MQTT broker and clients query the broker
+to pick a server — re-discovering an alternative when one dies
+(ref: gst/nnstreamer/tensor_query/README.md:76-80 "getting server info
+from broker", :79-80 re-discovery; connect-type enum
+tensor_query_common.c:30-40). Bulk tensor data never touches the broker;
+it rides the direct TCP/DCN connection, exactly like the reference.
+
+Liveness is connection-based (the reference gets this from MQTT's
+last-will): a server's REGISTER connection stays open for its lifetime,
+and the broker drops its advertisement the moment the connection closes.
+"""
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.log import logger
+from .protocol import MsgKind, recv_msg, send_msg
+
+
+class DiscoveryBroker:
+    """Topic -> [(host, port), ...] registry over the edge protocol.
+
+    Servers connect and send REGISTER {topic, host, port}, holding the
+    connection open; clients connect, send QUERY {topic}, and get a
+    QUERY_ACK {endpoints} in registration order.
+    """
+
+    def __init__(self, host: str = "localhost", port: int = 0):
+        self.host = host
+        self.port = port
+        self._listener: Optional[socket.socket] = None
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        # topic -> ordered list of (endpoint, owning socket)
+        self._topics: Dict[str, List[Tuple[Tuple[str, int],
+                                           socket.socket]]] = {}
+
+    @property
+    def bound_port(self) -> int:
+        return self._listener.getsockname()[1] if self._listener else self.port
+
+    def start(self) -> "DiscoveryBroker":
+        self._stop.clear()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((self.host, self.port))
+        self._listener.listen(32)
+        threading.Thread(target=self._accept_loop, name="broker-accept",
+                         daemon=True).start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+            self._listener = None
+
+    def endpoints(self, topic: str) -> List[Tuple[str, int]]:
+        with self._lock:
+            return [ep for ep, _ in self._topics.get(topic, [])]
+
+    # -- internals ----------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._conn_loop, args=(conn,),
+                             daemon=True).start()
+
+    def _conn_loop(self, conn: socket.socket) -> None:
+        registered: List[Tuple[str, Tuple[str, int]]] = []
+        try:
+            while not self._stop.is_set():
+                kind, meta, _ = recv_msg(conn)
+                if kind == MsgKind.REGISTER:
+                    topic = meta["topic"]
+                    ep = (meta["host"], int(meta["port"]))
+                    with self._lock:
+                        self._topics.setdefault(topic, []).append((ep, conn))
+                    registered.append((topic, ep))
+                    logger.info("broker: %s registered for topic %r",
+                                ep, topic)
+                elif kind == MsgKind.QUERY:
+                    send_msg(conn, MsgKind.QUERY_ACK,
+                             {"endpoints": self.endpoints(meta["topic"])})
+                else:
+                    break
+        except (ConnectionError, OSError, ValueError):
+            pass
+        finally:
+            # connection gone = server gone: drop its advertisements
+            # (≙ MQTT last-will removing a dead hybrid server)
+            if registered:
+                with self._lock:
+                    for topic, ep in registered:
+                        self._topics[topic] = [
+                            e for e in self._topics.get(topic, [])
+                            if e[1] is not conn]
+                logger.info("broker: dropped %d advertisement(s) on "
+                            "disconnect", len(registered))
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+def discover(broker_host: str, broker_port: int, topic: str,
+             timeout: float = 5.0) -> List[Tuple[str, int]]:
+    """One-shot client-side discovery: ask the broker who serves a topic."""
+    with socket.create_connection((broker_host, broker_port),
+                                  timeout=timeout) as s:
+        send_msg(s, MsgKind.QUERY, {"topic": topic})
+        kind, meta, _ = recv_msg(s)
+        if kind != MsgKind.QUERY_ACK:
+            raise ConnectionError(f"broker: unexpected reply {kind}")
+        return [(h, int(p)) for h, p in meta.get("endpoints", [])]

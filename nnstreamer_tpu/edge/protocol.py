@@ -36,6 +36,9 @@ class MsgKind(enum.IntEnum):
     EOS = 5
     ERROR = 6
     SUBSCRIBE = 7   # edgesrc -> edgesink hello
+    REGISTER = 8    # server -> broker: advertise topic at host:port
+    QUERY = 9       # client -> broker: who serves this topic?
+    QUERY_ACK = 10  # broker -> client: endpoint list
 
 
 def _read_exact(sock: socket.socket, n: int) -> bytes:

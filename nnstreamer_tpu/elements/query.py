@@ -11,6 +11,7 @@ edge-handle info "CAPS" (:537-562).
 """
 from __future__ import annotations
 
+import collections
 import socket
 import threading
 import time
@@ -56,6 +57,20 @@ class _ServerTable:
         with self._lock:
             return self._out_caps.get(server_id)
 
+    def close_server(self, server_id: int) -> None:
+        """Close every client connection of a stopping server so clients
+        see the death immediately and can fail over."""
+        with self._lock:
+            victims = [(k, s) for k, s in self._conns.items()
+                       if k[0] == server_id]
+            for k, _ in victims:
+                del self._conns[k]
+        for _, s in victims:
+            try:
+                s.close()
+            except OSError:
+                pass
+
 
 SERVER_TABLE = _ServerTable()
 _FLEX_CAPS = "other/tensors,format=flexible"
@@ -66,7 +81,12 @@ class TensorQueryServerSrc(SrcElement):
     """Server entry: listens for clients, pushes received frames into the
     server pipeline with the client id stamped in buffer extras."""
 
-    PROPS = {"host": "localhost", "port": 3001, "id": 0, "timeout": 10.0}
+    PROPS = {"host": "localhost", "port": 3001, "id": 0, "timeout": 10.0,
+             # HYBRID: advertise (topic -> host:port) on the discovery
+             # broker at dest-host:dest-port (≙ connect-type enum,
+             # tensor_query_common.c:30-40)
+             "connect-type": "TCP", "topic": "",
+             "dest-host": "localhost", "dest-port": 0}
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
@@ -75,6 +95,7 @@ class TensorQueryServerSrc(SrcElement):
         self._qlock = threading.Condition()
         self._next_client = [0]
         self._accept_thread: Optional[threading.Thread] = None
+        self._broker_sock: Optional[socket.socket] = None
 
     @property
     def bound_port(self) -> int:
@@ -92,16 +113,44 @@ class TensorQueryServerSrc(SrcElement):
             target=self._accept_loop, name=f"qsrc-accept:{self.name}",
             daemon=True)
         self._accept_thread.start()
+        if self.connect_type.upper() == "HYBRID":
+            # hold the registration connection open for our lifetime;
+            # the broker drops the advertisement the moment it closes
+            try:
+                self._broker_sock = socket.create_connection(
+                    (self.dest_host or "localhost", int(self.dest_port)),
+                    timeout=self.timeout)
+                send_msg(self._broker_sock, MsgKind.REGISTER,
+                         {"topic": self.topic, "host": self.host,
+                          "port": self.bound_port})
+            except OSError:
+                # don't leak a half-started server: closing the listener
+                # also terminates the accept thread
+                try:
+                    self._listener.close()
+                except OSError:
+                    pass
+                self._listener = None
+                raise
         super().start()
 
     def stop(self) -> None:
         super().stop()
+        if self._broker_sock is not None:
+            try:
+                self._broker_sock.close()
+            except OSError:
+                pass
+            self._broker_sock = None
         if self._listener is not None:
             try:
                 self._listener.close()
             except OSError:
                 pass
             self._listener = None
+        # drop live client connections so clients detect the death at
+        # once and fail over instead of timing out on a silent socket
+        SERVER_TABLE.close_server(self.id)
 
     def _accept_loop(self) -> None:
         while not self._stop_evt.is_set():
@@ -187,12 +236,22 @@ class TensorQueryServerSink(SinkElement):
 class TensorQueryClient(Element):
     """Client: sink-pad frames go to the server; results come back on the
     src pad. ``timeout`` guards the round trip (≙ timeout property +
-    CONNECTION_CLOSED handling)."""
+    CONNECTION_CLOSED handling).
+
+    Resilience (≙ tensor_query/README.md:79-80): on connection loss the
+    client reconnects with backoff; in ``connect-type=HYBRID`` it
+    re-queries the discovery broker at dest-host:dest-port for the
+    ``topic`` each attempt, so it fails over to an alternative server
+    when the one it was using dies. Unanswered frames are replayed on
+    the new connection (at-least-once: a frame whose *result* died with
+    the connection is recomputed, so a duplicate is possible; the
+    reference simply loses such frames)."""
 
     SINK_TEMPLATES = {"sink": "other/tensors"}
     SRC_TEMPLATES = {"src": "other/tensors"}
     PROPS = {"host": "localhost", "port": 3001, "dest-host": "",
-             "dest-port": 0, "timeout": 10.0, "max-request": 8}
+             "dest-port": 0, "timeout": 10.0, "max-request": 8,
+             "connect-type": "TCP", "topic": ""}
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
@@ -200,49 +259,142 @@ class TensorQueryClient(Element):
         self._recv_thread: Optional[threading.Thread] = None
         self._stop_evt = threading.Event()
         self._inflight = threading.Semaphore(max(1, self.max_request))
-        self._lock = threading.Lock()
+        self._send_lock = threading.Lock()
+        self._conn_lock = threading.Lock()
+        self._connect_mutex = threading.Lock()  # one (re)connect at a time
+        # unanswered requests, oldest first: replayed on reconnect so a
+        # server death loses no frames (at-least-once; results map back
+        # FIFO because the server pipeline preserves per-client order).
+        # Each entry is [meta, payloads, sent_generation]; comparing the
+        # generation against _conn_gen under _send_lock makes send and
+        # replay idempotent, so a frame is sent at most once per
+        # connection no matter how sender and reconnector interleave.
+        self._pending: "collections.deque" = collections.deque()
+        self._plock = threading.Lock()
+        self._conn_gen = 0
+        self._last_caps: Optional[Caps] = None
+        self._server_caps = _FLEX_CAPS
+        self.stats.update({"reconnects": 0})
 
-    def _target(self) -> Tuple[str, int]:
-        return (self.dest_host or self.host,
-                int(self.dest_port) or int(self.port))
+    def _endpoints(self, timeout: float) -> list:
+        """Candidate servers, most preferred first."""
+        if self.connect_type.upper() == "HYBRID":
+            from ..edge.broker import discover
+            eps = discover(self.dest_host or self.host,
+                           int(self.dest_port) or int(self.port),
+                           self.topic, timeout=timeout)
+            if eps:
+                return eps
+            raise ConnectionError(
+                f"{self.name}: no server for topic {self.topic!r}")
+        return [(self.dest_host or self.host,
+                 int(self.dest_port) or int(self.port))]
 
     def start(self) -> None:
         super().start()
         self._stop_evt.clear()
 
     def _connect(self, caps: Optional[Caps]) -> None:
-        host, port = self._target()
-        deadline = time.monotonic() + self.timeout
-        last_err = None
-        while time.monotonic() < deadline:
-            try:
-                self._sock = socket.create_connection((host, port),
-                                                      timeout=self.timeout)
-                break
-            except OSError as e:
-                last_err = e
-                time.sleep(0.05)
-        else:
+        """(Re)connect: discovery + handshake + pending replay, retried
+        with backoff until ``timeout``. Each retry re-discovers, so a
+        replacement server registered after a death is found."""
+        self._last_caps = caps or self._last_caps
+        with self._connect_mutex:
+            if self._sock is not None:
+                return  # lost the race: another thread reconnected
+            deadline = time.monotonic() + self.timeout
+            delay = 0.05
+            last_err: Optional[Exception] = None
+            while time.monotonic() < deadline and not self._stop_evt.is_set():
+                # every blocking step below is budgeted out of the SAME
+                # deadline so do_chain never stalls longer than ~timeout
+                remaining = deadline - time.monotonic()
+                try:
+                    for host, port in self._endpoints(remaining):
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        if self._try_endpoint(host, port, remaining):
+                            return
+                except (ConnectionError, OSError) as e:
+                    last_err = e
+                time.sleep(delay)
+                delay = min(delay * 2, 1.0)
             raise ConnectionError(
-                f"{self.name}: cannot connect to {host}:{port}: {last_err}")
-        send_msg(self._sock, MsgKind.CAPS, {"caps": str(caps or "")})
-        kind, meta, _ = recv_msg(self._sock)
-        if kind != MsgKind.CAPS_ACK:
-            raise ConnectionError(f"{self.name}: bad handshake {kind}")
-        self._server_caps = meta.get("caps", _FLEX_CAPS)
-        self._recv_thread = threading.Thread(
-            target=self._recv_loop, name=f"qclient-recv:{self.name}",
-            daemon=True)
-        self._recv_thread.start()
+                f"{self.name}: cannot reach a query server: {last_err}")
+
+    def _try_endpoint(self, host: str, port: int, timeout: float) -> bool:
+        """One connect+handshake+replay attempt; False = try next."""
+        try:
+            sock = socket.create_connection((host, port), timeout=timeout)
+        except OSError:
+            return False
+        try:
+            send_msg(sock, MsgKind.CAPS,
+                     {"caps": str(self._last_caps or "")})
+            kind, meta, _ = recv_msg(sock)
+            if kind != MsgKind.CAPS_ACK:
+                raise ConnectionError(f"{self.name}: bad handshake {kind}")
+            # handshake done: blocking mode for the long-lived recv loop
+            # (a lingering per-op timeout would kill idle connections),
+            # and caps published BEFORE the socket so a racing _connect
+            # caller never reads half-initialized state
+            sock.settimeout(None)
+            self._server_caps = meta.get("caps", _FLEX_CAPS)
+            with self._conn_lock:
+                self._sock = sock
+                self._conn_gen += 1
+                gen = self._conn_gen
+                self._inflight = threading.Semaphore(
+                    max(1, self.max_request))
+            self._recv_thread = threading.Thread(
+                target=self._recv_loop, args=(sock,),
+                name=f"qclient-recv:{self.name}", daemon=True)
+            self._recv_thread.start()
+            # replay unanswered frames in order on the new connection;
+            # the send lock is held across the whole replay so a new
+            # frame from the streaming thread cannot interleave and break
+            # the FIFO request->result pairing; the generation mark skips
+            # entries the streaming thread already sent on THIS connection
+            with self._send_lock:
+                with self._plock:
+                    replay = list(self._pending)
+                for entry in replay:
+                    if entry[2] == gen:
+                        continue
+                    if not self._inflight.acquire(timeout=self.timeout):
+                        raise ConnectionError(
+                            f"{self.name}: replay stalled")
+                    send_msg(sock, MsgKind.DATA, entry[0], entry[1])
+                    entry[2] = gen
+            return True
+        except (ConnectionError, OSError):
+            self._handle_disconnect(sock)
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return False
+
+    def _handle_disconnect(self, sock: Optional[socket.socket]) -> None:
+        """Tear down a failed connection (idempotent; ignores stale
+        sockets already replaced by a reconnect)."""
+        with self._conn_lock:
+            if sock is not None and sock is not self._sock:
+                return
+            old, self._sock = self._sock, None
+            # fresh permit pool: replies owed on the dead connection will
+            # never come, and blocked senders must not burn the timeout
+            self._inflight = threading.Semaphore(max(1, self.max_request))
+        if old is not None:
+            try:
+                old.close()
+            except OSError:
+                pass
 
     def stop(self) -> None:
         self._stop_evt.set()
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            except OSError:
-                pass
-            self._sock = None
+        self._handle_disconnect(None)
         super().stop()
 
     def on_sink_caps(self, pad: Pad, caps: Caps) -> None:
@@ -251,20 +403,59 @@ class TensorQueryClient(Element):
         self.set_src_caps(Caps(self._server_caps))
 
     def do_chain(self, pad: Pad, buf: Buffer) -> None:
-        if self._sock is None:
-            self._connect(pad.caps)
-            self.set_src_caps(Caps(self._server_caps))
-        if not self._inflight.acquire(timeout=self.timeout):
-            raise TimeoutError(f"{self.name}: server not answering")
         meta, payloads = buffer_to_wire(buf)
-        with self._lock:
-            send_msg(self._sock, MsgKind.DATA, meta, payloads)
+        self._last_caps = pad.caps or self._last_caps
+        entry = [meta, payloads, -1]  # -1 = not yet sent on any connection
+        with self._plock:
+            self._pending.append(entry)
+        for attempt in (1, 2):
+            sock = None
+            try:
+                if self._sock is None:
+                    self._connect(pad.caps)
+                    self.stats["reconnects"] += 1
+                    self.set_src_caps(Caps(self._server_caps))
+                with self._conn_lock:
+                    sock, gen = self._sock, self._conn_gen
+                    inflight = self._inflight
+                if sock is None:
+                    raise ConnectionError(f"{self.name}: not connected")
+                if entry[2] == gen:
+                    return  # a reconnect replay already sent our frame
+                if not inflight.acquire(timeout=self.timeout):
+                    raise TimeoutError(f"{self.name}: server not answering")
+                with self._send_lock:
+                    if entry[2] == gen:   # replay won the race meanwhile
+                        inflight.release()
+                    else:
+                        send_msg(sock, MsgKind.DATA, meta, payloads)
+                        entry[2] = gen
+                return
+            except (ConnectionError, OSError) as e:
+                # tear down only the socket the failure happened on; a
+                # racing reconnect may already have installed a fresh one
+                if sock is not None:
+                    self._handle_disconnect(sock)
+                if attempt == 2:
+                    with self._plock:
+                        try:
+                            self._pending.remove(entry)
+                        except ValueError:
+                            pass
+                    raise ConnectionError(
+                        f"{self.name}: send failed after reconnect: {e}") \
+                        from e
+                logger.warning("%s: connection lost, reconnecting (%s)",
+                               self.name, e)
 
-    def _recv_loop(self) -> None:
+    def _recv_loop(self, sock: socket.socket) -> None:
         try:
             while not self._stop_evt.is_set():
-                kind, meta, payloads = recv_msg(self._sock)
+                kind, meta, payloads = recv_msg(sock)
                 if kind == MsgKind.RESULT:
+                    with self._plock:
+                        if self._pending:
+                            self._pending.popleft()  # oldest is answered
                     # push before releasing: on_eos drains by acquiring all
                     # permits, so releasing first would let EOS overtake
                     # (and drop) this final result downstream
@@ -275,12 +466,31 @@ class TensorQueryClient(Element):
         except (ConnectionError, OSError):
             if not self._stop_evt.is_set():
                 logger.warning("%s: server connection closed", self.name)
+                # unblock senders so the next frame triggers a reconnect
+                self._handle_disconnect(sock)
+                with self._plock:
+                    owed = len(self._pending)
+                if owed:
+                    # answers are still owed: reconnect proactively so the
+                    # replay happens even if no new frame ever arrives
+                    threading.Thread(target=self._reconnect_bg,
+                                     name=f"qclient-reconn:{self.name}",
+                                     daemon=True).start()
+
+    def _reconnect_bg(self) -> None:
+        try:
+            self._connect(self._last_caps)
+            self.stats["reconnects"] += 1
+        except (ConnectionError, OSError) as e:
+            logger.warning("%s: background reconnect failed: %s",
+                           self.name, e)
 
     def on_eos(self) -> None:
         # drain in-flight requests before forwarding EOS
         deadline = time.monotonic() + self.timeout
+        inflight = self._inflight
         for _ in range(max(1, self.max_request)):
-            if not self._inflight.acquire(
+            if not inflight.acquire(
                     timeout=max(0.0, deadline - time.monotonic())):
                 break
         if self._sock is not None:

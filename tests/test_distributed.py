@@ -143,6 +143,95 @@ def test_edge_topic_mismatch_rejected():
     assert not sub["out"].buffers
 
 
+def test_hybrid_discovery_via_broker():
+    """Client finds the server through the discovery broker by topic
+    (≙ MQTT-hybrid connect-type, tensor_query/README.md:76-80)."""
+    from nnstreamer_tpu.edge import DiscoveryBroker, discover
+    broker = DiscoveryBroker(port=0).start()
+    server = parse_launch(
+        f'tensor_query_serversrc port=0 id=10 connect-type=HYBRID '
+        f'topic=scale dest-port={broker.bound_port} '
+        '! tensor_transform mode=arithmetic option=mul:2.0 '
+        '! tensor_query_serversink id=10')
+    server.start()
+    time.sleep(0.2)
+    assert discover("localhost", broker.bound_port, "scale")  # registered
+    client = parse_launch(
+        f'appsrc name=in caps="{CAPS}" '
+        f'! tensor_query_client connect-type=HYBRID topic=scale '
+        f'dest-port={broker.bound_port} timeout=15 '
+        '! appsink name=out')
+    client.start()
+    client["in"].push_buffer(Buffer.from_arrays([np.full(4, 5.0, np.float32)]))
+    deadline = time.monotonic() + 15
+    while not client["out"].buffers and time.monotonic() < deadline:
+        time.sleep(0.05)
+    client["in"].end_stream()
+    client.stop()
+    server.stop()
+    time.sleep(0.2)
+    # advertisement dropped once the server died (last-will semantics)
+    assert discover("localhost", broker.bound_port, "scale") == []
+    broker.stop()
+    out = client["out"].buffers
+    assert len(out) == 1
+    np.testing.assert_array_equal(out[0].chunks[0].host(),
+                                  np.full(4, 10.0, np.float32))
+
+
+def test_failover_to_alternative_server():
+    """Kill the serving pipeline mid-stream: the client re-discovers and
+    continues on the surviving server (≙ re-discovery when a hybrid
+    server dies, tensor_query/README.md:79-80)."""
+    from nnstreamer_tpu.edge import DiscoveryBroker
+    broker = DiscoveryBroker(port=0).start()
+
+    def mk_server(sid, mul):
+        return parse_launch(
+            f'tensor_query_serversrc port=0 id={sid} connect-type=HYBRID '
+            f'topic=ha dest-port={broker.bound_port} '
+            f'! tensor_transform mode=arithmetic option=mul:{mul} '
+            f'! tensor_query_serversink id={sid}')
+
+    s1, s2 = mk_server(11, 2.0), mk_server(12, 3.0)
+    s1.start()
+    time.sleep(0.2)
+    s2.start()
+    time.sleep(0.2)
+    client = parse_launch(
+        f'appsrc name=in caps="{CAPS}" '
+        f'! tensor_query_client name=qc connect-type=HYBRID topic=ha '
+        f'dest-port={broker.bound_port} timeout=15 '
+        '! appsink name=out')
+    client.start()
+
+    def ask(v, expect_n):
+        client["in"].push_buffer(Buffer.from_arrays(
+            [np.full(4, v, np.float32)]))
+        deadline = time.monotonic() + 15
+        while len(client["out"].buffers) < expect_n and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+
+    ask(1.0, 1)
+    assert len(client["out"].buffers) == 1
+    np.testing.assert_array_equal(client["out"].buffers[0].chunks[0].host(),
+                                  np.full(4, 2.0, np.float32))  # served by s1
+    s1.stop()  # kill the server mid-stream
+    time.sleep(0.2)
+    ask(1.0, 2)
+    client["in"].end_stream()
+    client.stop()
+    s2.stop()
+    broker.stop()
+    out = client["out"].buffers
+    assert len(out) == 2
+    # second answer came from the surviving x3 server
+    np.testing.assert_array_equal(out[1].chunks[0].host(),
+                                  np.full(4, 3.0, np.float32))
+    assert client["qc"].stats["reconnects"] >= 1
+
+
 def test_remote_filter_offload():
     """Client pipeline offloads inference to a server running the jax
     filter (the v5e fan-out seed: BASELINE config 5 semantics)."""
