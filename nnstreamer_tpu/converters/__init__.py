@@ -4,6 +4,7 @@
 the external-converter hook in gsttensor_converter.c (_NNS_MEDIA_ANY).
 """
 from . import registry
+from . import codecs  # noqa: F401  (register codec media converters)
 from .registry import ConverterPlugin, find_converter, register_converter
 
 __all__ = ["registry", "ConverterPlugin", "find_converter",

@@ -6,7 +6,7 @@ image_segment, tensor_region, ...).
 """
 from . import registry
 from .registry import DecoderPlugin, find_decoder, register_decoder
-from . import (bounding_box, direct_video, image_label,  # noqa: F401
-               pose, segment, tensor_region)
+from . import (bounding_box, codecs, direct_video, image_label,  # noqa: F401
+               pose, python3, segment, tensor_region)
 
 __all__ = ["registry", "DecoderPlugin", "find_decoder", "register_decoder"]

@@ -61,8 +61,12 @@ def nms(boxes: List[DetectedBox], threshold: float = 0.5) -> List[DetectedBox]:
 
 
 def draw_boxes(boxes: List[DetectedBox], width: int, height: int,
-               thickness: int = 2) -> np.ndarray:
-    """Rasterize box outlines onto a transparent RGBA canvas."""
+               thickness: int = 2,
+               labels: Optional[List[str]] = None) -> np.ndarray:
+    """Rasterize box outlines onto a transparent RGBA canvas; with
+    ``labels``, print each box's class name above it (≙ the reference's
+    bounding-box decoder + tensordec-font.c raster overlay)."""
+    from .font import GLYPH_H, draw_text
     canvas = np.zeros((height, width, 4), np.uint8)
     for b in boxes:
         color = _PALETTE[b.cls % len(_PALETTE)]
@@ -75,6 +79,10 @@ def draw_boxes(boxes: List[DetectedBox], width: int, height: int,
         canvas[max(0, y1 - t + 1):y1 + 1, x0:x1 + 1] = color
         canvas[y0:y1 + 1, x0:x0 + t] = color
         canvas[y0:y1 + 1, max(0, x1 - t + 1):x1 + 1] = color
+        if labels and b.cls < len(labels):
+            ty = y0 - GLYPH_H - 2
+            draw_text(canvas, x0, ty if ty >= 0 else y0 + t + 1,
+                      labels[b.cls], color)
     return canvas
 
 
@@ -99,6 +107,95 @@ class BoundingBoxes(DecoderPlugin):
                 self.conf_threshold = float(parts[1])
             if len(parts) > 2 and parts[2]:
                 self.iou_threshold = float(parts[2])
+        elif self.mode in ("mobilenet-ssd", "mobilenetssd", "tflite-ssd"):
+            self._parse_ssd_options(opt3)
+        elif self.mode == "mp-palm-detection":
+            self._parse_palm_options(opt3)
+
+    def _parse_ssd_options(self, opt3: str) -> None:
+        """option3 = <prior file>[:threshold:y_scale:x_scale:h_scale:
+        w_scale:iou] (≙ mobilenetssd.cc setOptionInternal; defaults
+        0.5/10/10/5/5/0.5)."""
+        parts = (opt3 or "").split(":")
+        if not parts or not parts[0]:
+            raise ValueError(
+                "mobilenet-ssd mode needs option3=<box-priors file>")
+        self._priors = self._load_box_priors(parts[0])
+        defaults = [0.5, 10.0, 10.0, 5.0, 5.0, 0.5]
+        for i in range(6):
+            if len(parts) > i + 1 and parts[i + 1]:
+                defaults[i] = float(parts[i + 1])
+        (self.conf_threshold, self._y_scale, self._x_scale,
+         self._h_scale, self._w_scale, self.iou_threshold) = defaults
+
+    @staticmethod
+    def _load_box_priors(path: str) -> np.ndarray:
+        """4 rows x N anchors (≙ mobilenet_ssd_loadBoxPrior)."""
+        rows = []
+        with open(path) as f:
+            for line in f:
+                vals = [float(v) for v in line.split()]
+                if vals:
+                    rows.append(vals)
+        if len(rows) < 4:
+            raise ValueError(
+                f"{path}: box-priors file needs 4 rows, got {len(rows)}")
+        return np.asarray(rows[:4], np.float32)
+
+    def _parse_palm_options(self, opt3: str) -> None:
+        """option3 = [min_score:num_layers:min_scale:max_scale:offset_x:
+        offset_y:stride0:...] (≙ mppalmdetection.cc setOptionInternal)."""
+        parts = [p for p in (opt3 or "").split(":")]
+        def _get(i, cast, default):
+            return cast(parts[i]) if len(parts) > i and parts[i] else default
+        self.conf_threshold = _get(0, float, 0.5)
+        num_layers = _get(1, int, 4)
+        min_scale = _get(2, float, 1.0)
+        max_scale = _get(3, float, 1.0)
+        offset_x = _get(4, float, 0.5)
+        offset_y = _get(5, float, 0.5)
+        defaults = [8, 16, 16, 16]
+        strides = [_get(6 + i, int,
+                        defaults[i] if i < len(defaults) else defaults[-1])
+                   for i in range(num_layers)]
+        if not self.option(5):
+            # anchors are generated for the 192x192 palm model; offsets
+            # must be scaled by the same input size, not the 640x480
+            # video default
+            self.in_w = self.in_h = 192
+        self._anchors = self._palm_anchors(num_layers, min_scale, max_scale,
+                                           offset_x, offset_y, strides)
+        self.iou_threshold = 0.05  # (≙ nms(results, 0.05f, ...) :367)
+
+    @staticmethod
+    def _palm_anchors(num_layers, min_scale, max_scale, offset_x, offset_y,
+                      strides) -> np.ndarray:
+        """SSD-style anchor grid for the 192x192 mediapipe palm model
+        (≙ mp_palm_detection_generate_anchors). Rows: (x_c, y_c, w, h)."""
+        def scale_for(idx):
+            if num_layers == 1:
+                return (min_scale + max_scale) * 0.5
+            return min_scale + (max_scale - min_scale) * idx / (num_layers - 1)
+
+        anchors = []
+        layer = 0
+        while layer < num_layers:
+            dims = []  # (w, h) per anchor at one cell
+            last = layer
+            while last < num_layers and strides[last] == strides[layer]:
+                for s_idx in (last, last + 1):
+                    sc = scale_for(s_idx)
+                    dims.append((sc, sc))  # aspect ratio 1 -> w = h = scale
+                last += 1
+            stride = strides[layer]
+            fm = int(np.ceil(192 / stride))
+            for y in range(fm):
+                for x in range(fm):
+                    for w, h in dims:
+                        anchors.append(((x + offset_x) / fm,
+                                        (y + offset_y) / fm, w, h))
+            layer = last
+        return np.asarray(anchors, np.float32)
 
     @staticmethod
     def _parse_wh(opt: str, default):
@@ -175,6 +272,52 @@ class BoundingBoxes(DecoderPlugin):
                                    int(classes.reshape(-1)[i]), s))
         return out
 
+    def _boxes_mobilenet_ssd(self, buf: Buffer) -> List[DetectedBox]:
+        """Raw SSD head + box-prior anchors: tensor0 = box deltas
+        [N, 4], tensor1 = class logits [N, labels]
+        (≙ mobilenetssd.cc _get_objects_mobilenet_ssd: per-anchor best
+        class >= threshold, prior-decoded center/size, then NMS)."""
+        deltas = buf.chunks[0].host().reshape(-1, 4).astype(np.float32)
+        logits = buf.chunks[1].host()
+        logits = logits.reshape(-1, logits.shape[-1]).astype(np.float32)
+        n = min(len(deltas), len(logits), self._priors.shape[1])
+        deltas, logits = deltas[:n], logits[:n]
+        pr = self._priors[:, :n]  # rows: [0]=yc [1]=xc [2]=h [3]=w
+        # best non-background class per anchor (class 0 is background)
+        cls = np.argmax(logits[:, 1:], axis=1) + 1
+        logit_best = logits[np.arange(n), cls]
+        score = 1.0 / (1.0 + np.exp(-np.clip(logit_best, -100, 100)))
+        keep = score >= self.conf_threshold
+        yc = deltas[:, 0] / self._y_scale * pr[2] + pr[0]
+        xc = deltas[:, 1] / self._x_scale * pr[3] + pr[1]
+        h = np.exp(deltas[:, 2] / self._h_scale) * pr[2]
+        w = np.exp(deltas[:, 3] / self._w_scale) * pr[3]
+        out = [DetectedBox(float(xc[i] - w[i] / 2), float(yc[i] - h[i] / 2),
+                           float(w[i]), float(h[i]), int(cls[i]),
+                           float(score[i]))
+               for i in np.nonzero(keep)[0]]
+        return nms(out, self.iou_threshold)
+
+    def _boxes_mp_palm(self, buf: Buffer) -> List[DetectedBox]:
+        """MediaPipe palm detection: tensor0 = boxes [N, >=4] (pixel
+        offsets vs 192-input anchors), tensor1 = score logits [N]
+        (≙ mppalmdetection.cc _get_objects_mp_palm_detection)."""
+        boxes = buf.chunks[0].host()
+        boxes = boxes.reshape(-1, boxes.shape[-1]).astype(np.float32)
+        scores = buf.chunks[1].host().reshape(-1).astype(np.float32)
+        n = min(len(boxes), len(scores), len(self._anchors))
+        a = self._anchors[:n]  # columns: x_c, y_c, w, h
+        score = 1.0 / (1.0 + np.exp(-np.clip(scores[:n], -100, 100)))
+        keep = score >= self.conf_threshold
+        yc = boxes[:n, 0] / self.in_h * a[:, 3] + a[:, 1]
+        xc = boxes[:n, 1] / self.in_w * a[:, 2] + a[:, 0]
+        h = boxes[:n, 2] / self.in_h * a[:, 3]
+        w = boxes[:n, 3] / self.in_w * a[:, 2]
+        out = [DetectedBox(float(xc[i] - w[i] / 2), float(yc[i] - h[i] / 2),
+                           float(w[i]), float(h[i]), 0, float(score[i]))
+               for i in np.nonzero(keep)[0]]
+        return nms(out, self.iou_threshold)
+
     def decode(self, buf: Buffer) -> Optional[Buffer]:
         if self.mode == "yolov5":
             boxes = self._boxes_yolov5(buf)
@@ -183,9 +326,14 @@ class BoundingBoxes(DecoderPlugin):
         elif self.mode in ("mobilenet-ssd-postprocess", "mobilenetssd-pp",
                            "tflite-ssd-postprocess"):
             boxes = self._boxes_ssd_pp(buf)
+        elif self.mode in ("mobilenet-ssd", "mobilenetssd", "tflite-ssd"):
+            boxes = self._boxes_mobilenet_ssd(buf)
+        elif self.mode == "mp-palm-detection":
+            boxes = self._boxes_mp_palm(buf)
         else:
             raise ValueError(f"bounding_boxes: unknown mode {self.mode!r}")
-        frame = draw_boxes(boxes, self.out_w, self.out_h)
+        frame = draw_boxes(boxes, self.out_w, self.out_h,
+                           labels=self._labels)
         out = Buffer([Chunk(frame)])
         out.extras["boxes"] = [
             {"x": b.x, "y": b.y, "w": b.w, "h": b.h, "class": b.cls,

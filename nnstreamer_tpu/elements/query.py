@@ -126,6 +126,12 @@ class TensorQueryServerSrc(SrcElement):
             except OSError:
                 # don't leak a half-started server: closing the listener
                 # also terminates the accept thread
+                if self._broker_sock is not None:
+                    try:
+                        self._broker_sock.close()
+                    except OSError:
+                        pass
+                    self._broker_sock = None
                 try:
                     self._listener.close()
                 except OSError:
@@ -348,7 +354,7 @@ class TensorQueryClient(Element):
                 self._inflight = threading.Semaphore(
                     max(1, self.max_request))
             self._recv_thread = threading.Thread(
-                target=self._recv_loop, args=(sock,),
+                target=self._recv_loop, args=(sock, self._inflight),
                 name=f"qclient-recv:{self.name}", daemon=True)
             self._recv_thread.start()
             # replay unanswered frames in order on the new connection;
@@ -431,6 +437,16 @@ class TensorQueryClient(Element):
                         send_msg(sock, MsgKind.DATA, meta, payloads)
                         entry[2] = gen
                 return
+            except TimeoutError:
+                # backpressure timeout, NOT a dead connection (it is an
+                # OSError subclass, so re-raise before the handler below
+                # tears down a healthy socket)
+                with self._plock:
+                    try:
+                        self._pending.remove(entry)
+                    except ValueError:
+                        pass
+                raise
             except (ConnectionError, OSError) as e:
                 # tear down only the socket the failure happened on; a
                 # racing reconnect may already have installed a fresh one
@@ -448,11 +464,20 @@ class TensorQueryClient(Element):
                 logger.warning("%s: connection lost, reconnecting (%s)",
                                self.name, e)
 
-    def _recv_loop(self, sock: socket.socket) -> None:
+    def _recv_loop(self, sock: socket.socket,
+                   inflight: threading.Semaphore) -> None:
         try:
             while not self._stop_evt.is_set():
                 kind, meta, payloads = recv_msg(sock)
                 if kind == MsgKind.RESULT:
+                    with self._conn_lock:
+                        stale = sock is not self._sock
+                    if stale:
+                        # our connection was replaced under us: the replay
+                        # on the new connection recomputes this frame, so
+                        # forwarding would duplicate it — and releasing
+                        # would inflate the NEW semaphore's permit pool
+                        continue
                     with self._plock:
                         if self._pending:
                             self._pending.popleft()  # oldest is answered
@@ -460,7 +485,7 @@ class TensorQueryClient(Element):
                     # permits, so releasing first would let EOS overtake
                     # (and drop) this final result downstream
                     self.srcpad.push(wire_to_buffer(meta, payloads))
-                    self._inflight.release()
+                    inflight.release()
                 elif kind == MsgKind.EOS:
                     break
         except (ConnectionError, OSError):

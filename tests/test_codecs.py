@@ -1,0 +1,231 @@
+"""Codec subplugin tests: tensors <-> flatbuf/flexbuf/protobuf/octet
+stream round trips (scope ≙ reference tests/nnstreamer_flatbuf,
+_flexbuf, _protobuf, decoder octet mode), python3 script decoder, and
+the label font overlay.
+"""
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nt
+from nnstreamer_tpu.interop import tensor_codec as tc
+
+CAPS = ('other/tensors,format=static,num_tensors=2,'
+        'types=(string)"float32,uint8",dimensions=(string)"4:2,3",'
+        'framerate=10/1')
+
+
+class TestWireCodecs:
+    @pytest.mark.parametrize("codec", ["flatbuf", "protobuf", "flexbuf"])
+    def test_round_trip(self, codec):
+        arrays = [np.arange(8, dtype=np.float32).reshape(2, 4),
+                  np.array([9, 8, 7], np.uint8),
+                  np.array([[1.5, -2.5]], np.float64)]
+        frame = tc.Frame(arrays, ["first", "second", ""], 30, 1)
+        out = getattr(tc, f"unpack_{codec}")(
+            getattr(tc, f"pack_{codec}")(frame))
+        assert out.rate_n == 30 and out.rate_d == 1
+        assert out.names[:2] == ["first", "second"]
+        for a, b in zip(arrays, out.arrays):
+            assert a.dtype == b.dtype
+            np.testing.assert_array_equal(a, b)
+
+    def test_flatbuf_parses_with_independent_reader(self):
+        # the writer (interop/flatbuild.py) and reader (interop/flatbuf.py,
+        # originally written for TFLite files) are independent
+        # implementations — agreement is a real format check
+        from nnstreamer_tpu.interop.flatbuf import FlatBuf
+        frame = tc.Frame([np.ones(5, np.float32)], ["t0"], 15, 1)
+        fb = FlatBuf(tc.pack_flatbuf(frame))
+        root = fb.root()
+        assert fb.field_scalar(root, 0, "i32") == 1          # num_tensor
+        vec = fb.field_vector(root, 2)
+        t = next(fb.vector_tables(vec))
+        assert fb.field_string(t, 0) == "t0"
+        assert fb.field_scalar(t, 1, "i32", 11) == 7          # NNS_FLOAT32
+
+
+class TestFlexbufReaderWidths:
+    def test_reads_minimal_width_buffer(self):
+        """A hand-laid-out flexbuffer for {"a": 5} using 1-byte widths —
+        the shape a spec-conformant minimal-width writer produces —
+        must parse, proving the reader is not locked to our writer's
+        32-bit slots."""
+        from nnstreamer_tpu.interop import flexbuf
+        buf = bytes([
+            ord("a"), 0,    # key "a\0"            @0
+            1,              # keys-vector length    @2
+            3,              # key offset (3-3=0)    @3
+            1,              # map: keys offset      @4 (4-1=3)
+            1,              # map: keys byte width  @5
+            1,              # map: length           @6
+            5,              # value slot (int 5)    @7
+            (flexbuf.INT << 2) | 0,   # packed type @8
+            2,              # root offset (9-2=7)   @9
+            (flexbuf.MAP << 2) | 0,   # root type
+            1,              # root byte width
+        ])
+        m = flexbuf.root(buf).as_map()
+        assert list(m) == ["a"]
+        assert m["a"].as_int() == 5
+
+
+class TestCodecPipelines:
+    @pytest.mark.parametrize("mode,mime", [
+        ("flatbuf", "other/flatbuf-tensor"),
+        ("flexbuf", "other/flexbuf"),
+        ("protobuf", "other/protobuf-tensor"),
+    ])
+    def test_decoder_converter_round_trip(self, mode, mime):
+        """tensors -> codec bytes -> tensors, mirroring the reference's
+        nnstreamer_flatbuf/_protobuf SSAT round-trip pipelines."""
+        p = nt.parse_launch(
+            f'tensortestsrc caps="{CAPS}" num-buffers=3 pattern=random '
+            f"seed=7 ! tee name=t "
+            f"t. ! appsink name=ref "
+            f"t. ! tensor_decoder mode={mode} ! tensor_converter ! "
+            "appsink name=out")
+        p.run(15)
+        ref, out = p["ref"].buffers, p["out"].buffers
+        assert len(out) == 3
+        for rb, ob in zip(ref, out):
+            assert len(ob.chunks) == 2
+            for rc, oc in zip(rb.chunks, ob.chunks):
+                np.testing.assert_array_equal(rc.host(), oc.host())
+
+    def test_decoder_emits_codec_mimetype(self):
+        p = nt.parse_launch(
+            f'tensortestsrc caps="{CAPS}" num-buffers=1 ! '
+            "tensor_decoder mode=flatbuf ! appsink name=out")
+        p.run(15)
+        assert p["out"].sinkpad.caps.structures[0].name == \
+            "other/flatbuf-tensor"
+
+    def test_octet_decoder(self):
+        p = nt.parse_launch(
+            f'tensortestsrc caps="{CAPS}" num-buffers=1 pattern=ones ! '
+            "tensor_decoder mode=octet_stream ! appsink name=out")
+        p.run(15)
+        buf = p["out"].buffers[0]
+        assert p["out"].sinkpad.caps.structures[0].name == \
+            "application/octet-stream"
+        # 2x4 float32 + 3 uint8 = 35 bytes of raw payload
+        assert buf.chunks[0].host().nbytes == 35
+
+    def test_octet_round_trip_via_converter(self):
+        """octet bytes back to tensors with explicit input-dim/type
+        (≙ gsttensor_converter.c octet mode)."""
+        caps1 = ('other/tensors,format=static,num_tensors=1,'
+                 'types=(string)float32,dimensions=(string)4,framerate=10/1')
+        p = nt.parse_launch(
+            f'tensortestsrc caps="{caps1}" num-buffers=2 pattern=counter ! '
+            "tensor_decoder mode=octet_stream ! "
+            "tensor_converter input-dim=4 input-type=float32 ! "
+            "appsink name=out")
+        p.run(15)
+        assert len(p["out"].buffers) == 2
+        np.testing.assert_array_equal(p["out"].buffers[1].chunks[0].host(),
+                                      np.ones(4, np.float32))
+
+
+class TestPythonDecoder:
+    def test_script_decoder(self, tmp_path):
+        script = tmp_path / "dec.py"
+        script.write_text(
+            "import numpy as np\n"
+            "from nnstreamer_tpu.tensors.buffer import Buffer, Chunk\n"
+            "def get_out_caps(config):\n"
+            "    return ('other/tensors,format=static,num_tensors=1,'\n"
+            "            'types=(string)float32,dimensions=(string)1')\n"
+            "def decode(buf):\n"
+            "    s = sum(float(c.host().sum()) for c in buf.chunks)\n"
+            "    return Buffer([Chunk(np.array([s], np.float32))])\n")
+        caps1 = ('other/tensors,format=static,num_tensors=1,'
+                 'types=(string)float32,dimensions=(string)4,framerate=0/1')
+        p = nt.parse_launch(
+            f'tensortestsrc caps="{caps1}" num-buffers=1 pattern=ones ! '
+            f"tensor_decoder mode=python3 option1={script} ! appsink name=o")
+        p.run(15)
+        np.testing.assert_allclose(p["o"].buffers[0].chunks[0].host(), [4.0])
+
+
+class TestMobilenetSSDAnchors:
+    def test_prior_decode(self, tmp_path):
+        """Zero deltas must decode to exactly the anchor boxes
+        (≙ mobilenetssd.cc prior math: yc = d0/ys*pr2 + pr0, ...)."""
+        from nnstreamer_tpu.decoders.registry import find_decoder
+        from nnstreamer_tpu.tensors.buffer import Buffer
+        # 3 anchors; rows: yc, xc, h, w
+        priors = tmp_path / "box_priors.txt"
+        priors.write_text("0.5 0.2 0.8\n"
+                          "0.5 0.3 0.7\n"
+                          "0.4 0.2 0.2\n"
+                          "0.6 0.3 0.2\n")
+        dec = find_decoder("bounding_boxes")()
+        dec.set_options(["mobilenet-ssd", "", str(priors), "64:64", "64:64",
+                         "", "", "", ""])
+        deltas = np.zeros((3, 4), np.float32)
+        logits = np.full((3, 4), -5.0, np.float32)  # 4 classes incl. bg
+        logits[1, 2] = 3.0                           # anchor 1 -> class 2
+        out = dec.decode(Buffer.from_arrays([deltas, logits]))
+        boxes = out.extras["boxes"]
+        assert len(boxes) == 1
+        b = boxes[0]
+        assert b["class"] == 2
+        assert b["score"] == pytest.approx(1 / (1 + np.exp(-3.0)), abs=1e-5)
+        # anchor 1: yc=.2 xc=.3 h=.2 w=.3 -> x=.15 y=.1
+        assert b["x"] == pytest.approx(0.15, abs=1e-6)
+        assert b["y"] == pytest.approx(0.10, abs=1e-6)
+        assert b["w"] == pytest.approx(0.30, abs=1e-6)
+        assert b["h"] == pytest.approx(0.20, abs=1e-6)
+
+    def test_missing_priors_rejected(self):
+        from nnstreamer_tpu.decoders.registry import find_decoder
+        dec = find_decoder("bounding_boxes")()
+        with pytest.raises(ValueError, match="box-priors"):
+            dec.set_options(["mobilenet-ssd", "", "", "", "", "", "", "",
+                             ""])
+
+
+class TestMpPalmDetection:
+    def test_anchor_grid_and_decode(self):
+        """num_layers=1 stride=8 on the 192 input -> 24x24 cells x 2
+        anchors (≙ mp_palm_detection_generate_anchors)."""
+        from nnstreamer_tpu.decoders.registry import find_decoder
+        from nnstreamer_tpu.tensors.buffer import Buffer
+        dec = find_decoder("bounding_boxes")()
+        dec.set_options(["mp-palm-detection", "", "0.5:1:1.0:1.0:0.5:0.5:8",
+                         "64:64", "192:192", "", "", "", ""])
+        assert dec._anchors.shape == (24 * 24 * 2, 4)
+        n = len(dec._anchors)
+        boxes = np.zeros((n, 18), np.float32)     # palm model: 18 values/box
+        boxes[0, 2:4] = 19.2                      # 19.2px on the 192 input
+        scores = np.full(n, -10.0, np.float32)
+        scores[0] = 3.0
+        out = dec.decode(Buffer.from_arrays([boxes, scores]))
+        got = out.extras["boxes"]
+        assert len(got) == 1
+        # anchor 0 center (0.5/24, 0.5/24); h = w = 19.2/192 * 1 = 0.1
+        assert got[0]["w"] == pytest.approx(0.1, abs=1e-6)
+        assert got[0]["x"] == pytest.approx(0.5 / 24 - 0.05, abs=1e-6)
+        assert got[0]["score"] == pytest.approx(1 / (1 + np.exp(-3.0)),
+                                                abs=1e-5)
+
+
+class TestFont:
+    def test_draw_text_marks_pixels(self):
+        from nnstreamer_tpu.decoders.font import draw_text
+        canvas = np.zeros((20, 60, 4), np.uint8)
+        draw_text(canvas, 1, 1, "AB 9", (255, 0, 0, 255))
+        assert (canvas[..., 0] == 255).sum() > 20
+        # clipping: drawing off-canvas must not raise
+        draw_text(canvas, 55, 18, "XYZ", (0, 255, 0, 255))
+        draw_text(canvas, -3, -3, "Q", (0, 255, 0, 255))
+
+    def test_bbox_labels_drawn(self, tmp_path):
+        from nnstreamer_tpu.decoders.bounding_box import (DetectedBox,
+                                                          draw_boxes)
+        frame_plain = draw_boxes([DetectedBox(0.2, 0.3, 0.4, 0.4, 0, 0.9)],
+                                 100, 100)
+        frame_lbl = draw_boxes([DetectedBox(0.2, 0.3, 0.4, 0.4, 0, 0.9)],
+                               100, 100, labels=["cat"])
+        assert (frame_lbl != frame_plain).any()
