@@ -173,6 +173,12 @@ class BoundingBoxes(DecoderPlugin):
         """SSD-style anchor grid for the 192x192 mediapipe palm model
         (≙ mp_palm_detection_generate_anchors). Rows: (x_c, y_c, w, h)."""
         def scale_for(idx):
+            # NB: for the second anchor of the last layer this evaluates
+            # at idx == num_layers, extrapolating past max_scale — that
+            # mirrors the reference exactly (mppalmdetection.cc:173-175
+            # calls _calculate_scale(last_same_stride_layer + 1, ...)),
+            # which itself diverges from upstream mediapipe's
+            # interpolated-scale variant. Parity wins here.
             if num_layers == 1:
                 return (min_scale + max_scale) * 0.5
             return min_scale + (max_scale - min_scale) * idx / (num_layers - 1)

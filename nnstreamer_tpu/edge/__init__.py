@@ -6,6 +6,8 @@ Here the control+data plane is a length-prefixed TCP protocol (DCN-side);
 in-pod scale-out instead uses jax.sharding over ICI (parallel/).
 """
 from .broker import DiscoveryBroker, discover
+from .mqtt import MqttBroker
 from .protocol import MsgKind, recv_msg, send_msg
 
-__all__ = ["MsgKind", "send_msg", "recv_msg", "DiscoveryBroker", "discover"]
+__all__ = ["MsgKind", "send_msg", "recv_msg", "DiscoveryBroker", "discover",
+           "MqttBroker"]

@@ -16,9 +16,10 @@ from __future__ import annotations
 
 import socket
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Tuple
 
 from ..utils.log import logger
+from .listener import TcpListener
 from .protocol import MsgKind, recv_msg, send_msg
 
 
@@ -31,10 +32,8 @@ class DiscoveryBroker:
     """
 
     def __init__(self, host: str = "localhost", port: int = 0):
-        self.host = host
-        self.port = port
-        self._listener: Optional[socket.socket] = None
-        self._stop = threading.Event()
+        self._listener = TcpListener(host, port, self._conn_loop,
+                                     name="broker-accept")
         self._lock = threading.Lock()
         # topic -> ordered list of (endpoint, owning socket)
         self._topics: Dict[str, List[Tuple[Tuple[str, int],
@@ -42,45 +41,24 @@ class DiscoveryBroker:
 
     @property
     def bound_port(self) -> int:
-        return self._listener.getsockname()[1] if self._listener else self.port
+        return self._listener.bound_port
 
     def start(self) -> "DiscoveryBroker":
-        self._stop.clear()
-        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._listener.bind((self.host, self.port))
-        self._listener.listen(32)
-        threading.Thread(target=self._accept_loop, name="broker-accept",
-                         daemon=True).start()
+        self._listener.start()
         return self
 
     def stop(self) -> None:
-        self._stop.set()
-        if self._listener is not None:
-            try:
-                self._listener.close()
-            except OSError:
-                pass
-            self._listener = None
+        self._listener.stop()
 
     def endpoints(self, topic: str) -> List[Tuple[str, int]]:
         with self._lock:
             return [ep for ep, _ in self._topics.get(topic, [])]
 
     # -- internals ----------------------------------------------------------
-    def _accept_loop(self) -> None:
-        while not self._stop.is_set():
-            try:
-                conn, _ = self._listener.accept()
-            except OSError:
-                return
-            threading.Thread(target=self._conn_loop, args=(conn,),
-                             daemon=True).start()
-
     def _conn_loop(self, conn: socket.socket) -> None:
         registered: List[Tuple[str, Tuple[str, int]]] = []
         try:
-            while not self._stop.is_set():
+            while not self._listener.stop_evt.is_set():
                 kind, meta, _ = recv_msg(conn)
                 if kind == MsgKind.REGISTER:
                     topic = meta["topic"]

@@ -1,0 +1,96 @@
+"""In-process MQTT-style message broker for tensor pub/sub.
+
+≙ the external MQTT broker (mosquitto) + Eclipse Paho client the
+reference's gst/mqtt elements talk to (mqttsink.c:29). Carries whole
+messages (caps header + base-time + buffer payload) between publishers
+and subscribers by topic; subscribers attach with SUBSCRIBE, publishers
+push PUBLISH frames, the broker fans out. A trailing ``#`` in a
+subscription matches any topic with that prefix (MQTT wildcard).
+
+Unlike the query DiscoveryBroker (control plane only), this broker is a
+data plane: the tensor bytes flow through it, exactly like raw
+GstBuffer-over-MQTT in the reference.
+"""
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Dict, List, Tuple
+
+from ..utils.log import logger
+from .listener import TcpListener
+from .protocol import MsgKind, recv_msg, send_msg
+
+
+def _topic_matches(sub: str, topic: str) -> bool:
+    if sub.endswith("#"):
+        return topic.startswith(sub[:-1])
+    return sub == topic
+
+
+class MqttBroker:
+    """Minimal topic fan-out broker over the edge framing."""
+
+    def __init__(self, host: str = "localhost", port: int = 0):
+        self._listener = TcpListener(host, port, self._conn_loop,
+                                     name="mqtt-broker", backlog=64)
+        self._lock = threading.Lock()
+        # subscriber conn -> (subscription topics, per-conn send lock)
+        self._subs: Dict[socket.socket,
+                         Tuple[List[str], threading.Lock]] = {}
+
+    @property
+    def bound_port(self) -> int:
+        return self._listener.bound_port
+
+    def start(self) -> "MqttBroker":
+        self._listener.start()
+        return self
+
+    def stop(self) -> None:
+        self._listener.stop()
+        with self._lock:
+            conns = list(self._subs)
+            self._subs.clear()
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def _conn_loop(self, conn: socket.socket) -> None:
+        try:
+            while not self._listener.stop_evt.is_set():
+                kind, meta, payloads = recv_msg(conn)
+                if kind == MsgKind.SUBSCRIBE:
+                    with self._lock:
+                        topics, lock = self._subs.setdefault(
+                            conn, ([], threading.Lock()))
+                        topics.append(meta["topic"])
+                elif kind == MsgKind.PUBLISH:
+                    self._fan_out(meta, payloads)
+                else:
+                    break
+        except (ConnectionError, OSError, ValueError):
+            pass
+        finally:
+            with self._lock:
+                self._subs.pop(conn, None)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _fan_out(self, meta: Dict, payloads: List[bytes]) -> None:
+        topic = meta.get("topic", "")
+        with self._lock:
+            targets = [(c, lock) for c, (topics, lock) in self._subs.items()
+                       if any(_topic_matches(t, topic) for t in topics)]
+        for conn, lock in targets:
+            try:
+                with lock:  # serialize per subscriber, not globally
+                    send_msg(conn, MsgKind.PUBLISH, meta, payloads)
+            except (ConnectionError, OSError):
+                with self._lock:
+                    self._subs.pop(conn, None)
+                logger.info("mqtt broker: dropped dead subscriber")

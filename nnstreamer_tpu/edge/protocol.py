@@ -39,6 +39,7 @@ class MsgKind(enum.IntEnum):
     REGISTER = 8    # server -> broker: advertise topic at host:port
     QUERY = 9       # client -> broker: who serves this topic?
     QUERY_ACK = 10  # broker -> client: endpoint list
+    PUBLISH = 11    # publisher -> message broker: topic payload
 
 
 def _read_exact(sock: socket.socket, n: int) -> bytes:

@@ -17,5 +17,8 @@ from . import trainer  # noqa: F401  (tensor_trainer)
 from . import datarepo  # noqa: F401  (datareposrc/datareposink)
 from . import query  # noqa: F401  (tensor_query_client/serversrc/serversink)
 from . import edge  # noqa: F401  (edgesrc/edgesink)
+from . import mqtt  # noqa: F401  (mqttsrc/mqttsink)
+from . import grpc  # noqa: F401  (tensor_src_grpc/tensor_sink_grpc)
+from . import iio  # noqa: F401  (tensor_src_iio)
 
 __all__: list = []

@@ -1,0 +1,270 @@
+"""tensor_src_grpc / tensor_sink_grpc — RPC tensor bridge with
+protobuf or flatbuf IDL.
+
+≙ ext/nnstreamer/tensor_source/tensor_src_grpc.c +
+tensor_sink/tensor_sink_grpc.c over the C++ core in
+ext/nnstreamer/extra/nnstreamer_grpc*.cc: the TensorService of
+nnstreamer.proto / nnstreamer.fbs (client-streaming SendTensors,
+server-streaming RecvTensors), with ``server``, ``host``/``port`` and
+``idl=protobuf|flatbuf`` properties, and either element able to play
+either role (4 topologies).
+
+The grpc C++ stack is not a dependency here; the transport is the edge
+framing (length-prefixed TCP) carrying ONE IDL-serialized ``Tensors``
+message per frame — the same messages a gRPC stream would carry, so the
+IDL layer (interop/tensor_codec.py) is shared and the payloads are
+byte-identical to the reference schemas.
+"""
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import List, Optional
+
+from ..interop import tensor_codec as tc
+from ..edge.listener import TcpListener
+from ..edge.protocol import MsgKind, recv_msg, send_msg
+from ..pipeline.element import SinkElement, SrcElement
+from ..pipeline.pad import Pad
+from ..pipeline.registry import register_element
+from ..tensors.buffer import Buffer, Chunk
+from ..tensors.caps import Caps
+from ..tensors.info import TensorInfo, TensorsConfig, TensorsInfo
+from ..tensors.types import TensorType
+from ..utils.log import logger
+
+_IDL = {
+    "protobuf": (tc.pack_protobuf, tc.unpack_protobuf),
+    "flatbuf": (tc.pack_flatbuf, tc.unpack_flatbuf),
+}
+
+
+def _caps_for_frame(frame: tc.Frame) -> Caps:
+    infos = TensorsInfo(
+        TensorInfo(n or None, TensorType.from_dtype(a.dtype), a.shape)
+        for n, a in zip(frame.names, frame.arrays))
+    return Caps.from_config(TensorsConfig(
+        infos, rate_n=frame.rate_n, rate_d=frame.rate_d))
+
+
+class _Endpoint:
+    """Shared client/server plumbing: either listen() and collect peer
+    connections, or dial out to one peer."""
+
+    def __init__(self, element, is_server: bool, host: str, port: int):
+        self.element = element
+        self.is_server = is_server
+        self.host, self.port = host, int(port)
+        self.listener: Optional[TcpListener] = None
+        self.peers: List[socket.socket] = []
+        self.peers_changed = threading.Condition()
+        self.lock = threading.Lock()
+        self.stop_evt = threading.Event()
+
+    @property
+    def bound_port(self) -> int:
+        return self.listener.bound_port if self.listener else self.port
+
+    def _add_peer(self, conn: socket.socket) -> None:
+        with self.lock:
+            self.peers.append(conn)
+        with self.peers_changed:
+            self.peers_changed.notify_all()
+
+    def open(self, on_peer) -> None:
+        self.stop_evt.clear()
+        if self.is_server:
+            def handle(conn):
+                self._add_peer(conn)
+                on_peer(conn)
+            self.listener = TcpListener(
+                self.host, self.port, handle, backlog=16,
+                name=f"grpc-accept:{self.element.name}", spawn_thread=False)
+            self.listener.start()
+        else:
+            conn = socket.create_connection((self.host, self.port),
+                                            timeout=10.0)
+            # the connect timeout must not linger as a per-op timeout:
+            # an idle stream would be torn down after 10 s regardless of
+            # the element's own 'timeout' property
+            conn.settimeout(None)
+            self._add_peer(conn)
+            on_peer(conn)
+
+    def close(self) -> None:
+        self.stop_evt.set()
+        if self.listener is not None:
+            self.listener.stop()
+            self.listener = None
+        with self.lock:
+            peers, self.peers = self.peers, []
+        for p in peers:
+            try:
+                p.close()
+            except OSError:
+                pass
+        with self.peers_changed:
+            self.peers_changed.notify_all()
+
+    def drop(self, conn: socket.socket) -> None:
+        with self.lock:
+            if conn in self.peers:
+                self.peers.remove(conn)
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+
+@register_element("tensor_sink_grpc")
+class GrpcSink(SinkElement):
+    """Outbound: serializes each tensors frame to the IDL and streams it
+    to the peer(s) — SendTensors when client, RecvTensors feed when
+    server."""
+
+    PROPS = {"host": "localhost", "port": 55115, "server": True,
+             "blocking": True, "idl": "protobuf", "silent": True}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._ep: Optional[_Endpoint] = None
+        self._config: Optional[TensorsConfig] = None
+
+    @property
+    def bound_port(self) -> int:
+        return self._ep.bound_port if self._ep else self.port
+
+    def start(self) -> None:
+        super().start()
+        if self.idl not in _IDL:
+            raise ValueError(f"{self.name}: unknown idl {self.idl!r} "
+                             "(protobuf|flatbuf)")
+        self._ep = _Endpoint(self, self.server, self.host, self.port)
+        self._ep.open(lambda conn: None)  # sink peers just receive
+
+    def stop(self) -> None:
+        if self._ep is not None:
+            self._ep.close()
+            self._ep = None
+        super().stop()
+
+    def on_sink_caps(self, pad: Pad, caps: Caps) -> None:
+        self._config = caps.to_config()
+
+    def handle_event(self, pad, event) -> None:
+        from ..pipeline.events import CapsEvent
+        if isinstance(event, CapsEvent):
+            pad.set_caps(event.caps)
+            self.on_sink_caps(pad, event.caps)
+            return
+        super().handle_event(pad, event)
+
+    def render(self, buf: Buffer) -> None:
+        cfg = self._config
+        names = ([i.name or "" for i in cfg.info]
+                 if cfg and len(cfg.info) else None)
+        frame = tc.Frame([c.host() for c in buf.chunks], names,
+                         cfg.rate_n if cfg else 0,
+                         cfg.rate_d if cfg else 1)
+        payload = _IDL[self.idl][0](frame)
+        with self._ep.lock:
+            peers = list(self._ep.peers)
+        if not peers and self.blocking:
+            # blocking mode (≙ the reference's 'blocking' sync stream):
+            # wait for a consumer instead of dropping the frame
+            deadline = time.monotonic() + 10.0
+            with self._ep.peers_changed:
+                while not self._ep.stop_evt.is_set():
+                    with self._ep.lock:
+                        peers = list(self._ep.peers)
+                    if peers or time.monotonic() > deadline:
+                        break
+                    self._ep.peers_changed.wait(timeout=0.1)
+        if not peers and not self.silent:
+            logger.warning("%s: no connected peer, frame dropped", self.name)
+        for conn in peers:
+            try:
+                send_msg(conn, MsgKind.DATA, {"idl": self.idl}, [payload])
+            except (ConnectionError, OSError):
+                self._ep.drop(conn)
+
+
+@register_element("tensor_src_grpc")
+class GrpcSrc(SrcElement):
+    """Inbound: receives IDL-serialized tensors frames from the peer(s)
+    — SendTensors service when server, RecvTensors consumer when
+    client — and pushes them into the pipeline."""
+
+    # (no 'blocking' knob here: the src is inherently pull-blocking via
+    # 'timeout'; an ignored property would mislead, so it is omitted)
+    PROPS = {"host": "localhost", "port": 55115, "server": True,
+             "idl": "protobuf", "silent": True, "timeout": 10.0}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._ep: Optional[_Endpoint] = None
+        self._queue: List[tc.Frame] = []
+        self._qcond = threading.Condition()
+        self._caps_sent = False
+
+    @property
+    def bound_port(self) -> int:
+        return self._ep.bound_port if self._ep else self.port
+
+    def negotiate_src_caps(self) -> Optional[Caps]:
+        return None  # caps derive from the first received frame
+
+    def start(self) -> None:
+        if self.idl not in _IDL:
+            raise ValueError(f"{self.name}: unknown idl {self.idl!r} "
+                             "(protobuf|flatbuf)")
+        self._ep = _Endpoint(self, self.server, self.host, self.port)
+        self._caps_sent = False
+        self._ep.open(self._spawn_recv)
+        super().start()
+
+    def _spawn_recv(self, conn: socket.socket) -> None:
+        threading.Thread(target=self._recv_loop, args=(conn,), daemon=True,
+                         name=f"grpc-recv:{self.name}").start()
+
+    def _recv_loop(self, conn: socket.socket) -> None:
+        unpack = _IDL[self.idl][1]
+        ep = self._ep  # stop() nulls the attribute while we run
+        try:
+            while not ep.stop_evt.is_set():
+                kind, meta, payloads = recv_msg(conn)
+                if kind != MsgKind.DATA or not payloads:
+                    break
+                frame = unpack(payloads[0])
+                with self._qcond:
+                    self._queue.append(frame)
+                    self._qcond.notify_all()
+        except (ConnectionError, OSError, ValueError):
+            pass
+        finally:
+            ep.drop(conn)
+
+    def stop(self) -> None:
+        if self._ep is not None:
+            self._ep.close()
+            self._ep = None
+        with self._qcond:
+            self._qcond.notify_all()
+        super().stop()
+
+    def create(self) -> Optional[Buffer]:
+        deadline = time.monotonic() + self.timeout
+        with self._qcond:
+            while not self._queue:
+                if self._stop_evt.is_set() or time.monotonic() > deadline:
+                    if not self.silent and not self._stop_evt.is_set():
+                        logger.warning("%s: no frame within timeout",
+                                       self.name)
+                    return None
+                self._qcond.wait(timeout=0.1)
+            frame = self._queue.pop(0)
+        if not self._caps_sent:
+            self.set_src_caps(_caps_for_frame(frame))
+            self._caps_sent = True
+        return Buffer([Chunk(a) for a in frame.arrays])

@@ -1,0 +1,159 @@
+"""mqttsink / mqttsrc — tensor streams over a message broker, with
+cross-device base-time synchronization.
+
+≙ gst/mqtt/mqttsink.c + mqttsrc.c (GstBuffer over Paho MQTT): each
+published message carries the caps string plus the publisher pipeline's
+base-time converted to epoch time; the subscriber re-times buffers into
+its own clock domain:
+
+    abs_ts  = pub_base_time_epoch + pts          (publisher side)
+    new_pts = abs_ts - sub_base_time_epoch        (subscriber side)
+
+(ref: Documentation/synchronization-in-mqtt-elements.md). With
+``ntp-sync=true`` the base-time epoch is taken from the configured NTP
+servers (``ntp-srvs``, ≙ mqtt-ntp-sync/mqtt-ntp-srvs + ntputil.c)
+instead of the local clock, so devices whose clocks drift still agree.
+The broker is edge/mqtt.py's MqttBroker (or anything speaking the same
+framing).
+"""
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Optional
+
+from ..edge.ntp import synced_epoch_ns
+from ..edge.protocol import (MsgKind, buffer_to_wire, recv_msg, send_msg,
+                             wire_to_buffer)
+from ..pipeline.element import SinkElement, SrcElement
+from ..pipeline.pad import Pad
+from ..pipeline.registry import register_element
+from ..tensors.buffer import Buffer
+from ..tensors.caps import Caps
+from ..utils.log import logger
+
+
+@register_element("mqttsink")
+class MqttSink(SinkElement):
+    PROPS = {"host": "localhost", "port": 1883, "pub-topic": "",
+             "ntp-sync": False, "ntp-srvs": "pool.ntp.org:123",
+             "ntp-timeout": 2.0, "debug": False}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._sock: Optional[socket.socket] = None
+        self._send_lock = threading.Lock()
+        self._caps_str = ""
+        self._base_epoch_ns = 0
+        self._base_mono_ns = 0
+
+    def start(self) -> None:
+        super().start()
+        if not self.pub_topic:
+            raise ValueError(f"{self.name}: 'pub-topic' is required")
+        # base-time: the universal-time instant this sink went live
+        self._base_epoch_ns = synced_epoch_ns(
+            self.ntp_srvs if self.ntp_sync else None, self.ntp_timeout)
+        self._base_mono_ns = time.monotonic_ns()
+        self._sock = socket.create_connection((self.host, int(self.port)),
+                                              timeout=10.0)
+
+    def stop(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        super().stop()
+
+    def on_sink_caps(self, pad: Pad, caps: Caps) -> None:
+        self._caps_str = str(caps)
+
+    def handle_event(self, pad, event) -> None:
+        from ..pipeline.events import CapsEvent
+        if isinstance(event, CapsEvent):
+            pad.set_caps(event.caps)
+            self.on_sink_caps(pad, event.caps)
+            return
+        super().handle_event(pad, event)
+
+    def render(self, buf: Buffer) -> None:
+        meta, payloads = buffer_to_wire(buf)
+        meta["topic"] = self.pub_topic
+        meta["caps"] = self._caps_str
+        meta["base_time_epoch_ns"] = self._base_epoch_ns
+        if buf.pts is None:
+            # no timestamp: synthesize the running time at publish
+            meta["pts"] = time.monotonic_ns() - self._base_mono_ns
+        with self._send_lock:
+            send_msg(self._sock, MsgKind.PUBLISH, meta, payloads)
+        if self.debug:
+            logger.info("%s: published pts=%s to %s", self.name,
+                        meta["pts"], self.pub_topic)
+
+
+@register_element("mqttsrc")
+class MqttSrc(SrcElement):
+    PROPS = {"host": "localhost", "port": 1883, "sub-topic": "",
+             "ntp-sync": False, "ntp-srvs": "pool.ntp.org:123",
+             "ntp-timeout": 2.0, "timeout": 10.0, "is-live": True,
+             "debug": False}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._sock: Optional[socket.socket] = None
+        self._base_epoch_ns = 0
+        self._caps_sent = False
+
+    def negotiate_src_caps(self) -> Optional[Caps]:
+        # caps arrive with the first message; negotiated in-stream
+        return None
+
+    def start(self) -> None:
+        if not self.sub_topic:
+            raise ValueError(f"{self.name}: 'sub-topic' is required")
+        self._base_epoch_ns = synced_epoch_ns(
+            self.ntp_srvs if self.ntp_sync else None, self.ntp_timeout)
+        self._sock = socket.create_connection((self.host, int(self.port)),
+                                              timeout=self.timeout)
+        self._sock.settimeout(self.timeout)
+        send_msg(self._sock, MsgKind.SUBSCRIBE, {"topic": self.sub_topic})
+        self._caps_sent = False
+        super().start()
+
+    def stop(self) -> None:
+        ss = self._sock
+        self._sock = None
+        if ss is not None:
+            try:
+                ss.close()
+            except OSError:
+                pass
+        super().stop()
+
+    def create(self) -> Optional[Buffer]:
+        while not self._stop_evt.is_set():
+            try:
+                kind, meta, payloads = recv_msg(self._sock)
+            except socket.timeout:
+                logger.warning("%s: no message within timeout", self.name)
+                return None
+            except (ConnectionError, OSError):
+                return None
+            if kind != MsgKind.PUBLISH:
+                continue
+            if not self._caps_sent and meta.get("caps"):
+                self.set_src_caps(Caps(meta["caps"]))
+                self._caps_sent = True
+            buf = wire_to_buffer(meta, payloads)
+            # re-time into this pipeline's clock domain (see module doc)
+            pub_base = meta.get("base_time_epoch_ns")
+            if buf.pts is not None and pub_base is not None:
+                abs_ts = pub_base + buf.pts
+                buf.pts = max(0, abs_ts - self._base_epoch_ns)
+            if self.debug:
+                logger.info("%s: received pts=%s", self.name, buf.pts)
+            return buf
+        return None

@@ -1,0 +1,89 @@
+"""tensor_src_grpc / tensor_sink_grpc bridge tests (scope ≙ reference
+tests/nnstreamer_grpc: localhost src/sink pairs in both server/client
+topologies and both IDLs)."""
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import Buffer, parse_launch
+
+CAPS = ('other/tensors,format=static,num_tensors=2,'
+        'types=(string)"float32,uint8",dimensions=(string)"4,2:3"')
+
+
+def _free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _push_and_wait(pub, sub, n=3):
+    for i in range(n):
+        pub["in"].push_buffer(Buffer.from_arrays(
+            [np.full(4, float(i), np.float32),
+             np.full((3, 2), i, np.uint8)]))
+    deadline = time.monotonic() + 10
+    while len(sub["out"].buffers) < n and time.monotonic() < deadline:
+        time.sleep(0.05)
+    pub["in"].end_stream()
+
+
+@pytest.mark.parametrize("idl", ["protobuf", "flatbuf"])
+def test_sink_server_src_client(idl):
+    """sink is the gRPC server (RecvTensors), src dials in as client."""
+    port = _free_port()
+    pub = parse_launch(
+        f'appsrc name=in caps="{CAPS}" '
+        f'! tensor_sink_grpc server=true port={port} idl={idl}')
+    pub.start()
+    time.sleep(0.2)
+    sub = parse_launch(
+        f'tensor_src_grpc server=false port={port} idl={idl} timeout=10 '
+        '! appsink name=out')
+    sub.start()
+    time.sleep(0.2)
+    _push_and_wait(pub, sub)
+    sub.stop()
+    pub.stop()
+    out = sub["out"].buffers
+    assert len(out) == 3
+    for i, b in enumerate(out):
+        np.testing.assert_array_equal(b.chunks[0].host(),
+                                      np.full(4, float(i), np.float32))
+        assert b.chunks[1].host().shape == (3, 2)
+    # static caps were derived from the IDL payload
+    cfg = sub["out"].sinkpad.caps.to_config()
+    assert cfg.info[0].shape == (4,)
+    assert cfg.info[1].shape == (3, 2)
+
+
+@pytest.mark.parametrize("idl", ["protobuf", "flatbuf"])
+def test_src_server_sink_client(idl):
+    """src is the gRPC server (SendTensors service), sink streams in."""
+    port = _free_port()
+    sub = parse_launch(
+        f'tensor_src_grpc server=true port={port} idl={idl} timeout=10 '
+        '! appsink name=out')
+    sub.start()
+    time.sleep(0.2)
+    pub = parse_launch(
+        f'appsrc name=in caps="{CAPS}" '
+        f'! tensor_sink_grpc server=false port={port} idl={idl}')
+    pub.start()
+    time.sleep(0.2)
+    _push_and_wait(pub, sub)
+    sub.stop()
+    pub.stop()
+    assert len(sub["out"].buffers) == 3
+
+
+def test_unknown_idl_rejected():
+    p = parse_launch(
+        'tensor_src_grpc idl=capnproto ! fakesink')
+    with pytest.raises(ValueError, match="unknown idl"):
+        p.start()
+    p.stop()

@@ -1,0 +1,144 @@
+"""tensor_src_iio tests against a fake sysfs tree (scope ≙ reference
+gsttensor_srciio.c: channel enumeration, type-string parsing with
+shift/mask/sign-extension, scale/offset application, merge semantics)."""
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import parse_launch
+
+
+def make_device(tmp_path, samples, name="fake_accel"):
+    """Fake IIO tree: 2 enabled s16 channels + 1 disabled, plus a raw
+    device node holding interleaved little-endian frames."""
+    base = tmp_path / "sys"
+    dev = base / "iio:device0"
+    scan = dev / "scan_elements"
+    scan.mkdir(parents=True)
+    (dev / "name").write_text(name + "\n")
+    for ch, idx, en in (("in_accel_x", 0, 1), ("in_accel_y", 1, 1),
+                        ("in_accel_z", 2, 0)):
+        (scan / f"{ch}_en").write_text(str(en))
+        (scan / f"{ch}_index").write_text(str(idx))
+        (scan / f"{ch}_type").write_text("le:s16/16>>0\n")
+    (dev / "in_accel_x_scale").write_text("0.5")
+    (dev / "in_accel_y_offset").write_text("10")
+    devdir = tmp_path / "dev"
+    devdir.mkdir()
+    payload = b"".join(struct.pack("<hh", x, y) for x, y in samples)
+    (devdir / "iio:device0").write_bytes(payload)
+    return base, devdir
+
+
+def test_continuous_merged(tmp_path):
+    samples = [(100, -2), (200, 4), (-300, 6), (400, 8)]
+    base, devdir = make_device(tmp_path, samples)
+    p = parse_launch(
+        f'tensor_src_iio device=fake_accel base-dir={base} '
+        f'dev-dir={devdir} buffer-capacity=2 num-buffers=2 '
+        '! appsink name=out')
+    p.run(15)
+    out = p["out"].buffers
+    assert len(out) == 2
+    arr = np.concatenate([b.chunks[0].host() for b in out])
+    assert arr.shape == (4, 2)
+    # x scaled by 0.5; y offset by +10
+    np.testing.assert_allclose(arr[:, 0], [50, 100, -150, 200])
+    np.testing.assert_allclose(arr[:, 1], [8, 14, 16, 18])
+    # disabled channel z excluded in channels=auto
+    cfg = p["out"].sinkpad.caps.to_config()
+    assert cfg.info[0].shape == (2, 2)
+
+
+def test_unmerged_channels(tmp_path):
+    base, devdir = make_device(tmp_path, [(1, 2), (3, 4)])
+    p = parse_launch(
+        f'tensor_src_iio device-number=0 base-dir={base} dev-dir={devdir} '
+        'buffer-capacity=2 num-buffers=1 merge-channels-data=false '
+        '! appsink name=out')
+    p.run(15)
+    buf = p["out"].buffers[0]
+    assert len(buf.chunks) == 2
+    np.testing.assert_allclose(buf.chunks[0].host().ravel(), [0.5, 1.5])
+    np.testing.assert_allclose(buf.chunks[1].host().ravel(), [12, 14])
+    cfg = p["out"].sinkpad.caps.to_config()
+    assert len(cfg.info) == 2
+    assert cfg.info[0].shape == (2, 1)
+
+
+def test_shift_and_mask(tmp_path):
+    """le:s12/16>>4: 12 used bits stored in the high nibble-shifted u16
+    (≙ the reference's shift/mask/sign-extend macro)."""
+    base = tmp_path / "sys"
+    dev = base / "iio:device0"
+    scan = dev / "scan_elements"
+    scan.mkdir(parents=True)
+    (dev / "name").write_text("adc\n")
+    (scan / "in_voltage0_en").write_text("1")
+    (scan / "in_voltage0_index").write_text("0")
+    (scan / "in_voltage0_type").write_text("le:s12/16>>4")
+    devdir = tmp_path / "dev"
+    devdir.mkdir()
+    # raw values 100 and -5, pre-shifted left by 4
+    vals = [100 << 4, (-5 & 0xFFF) << 4]
+    (devdir / "iio:device0").write_bytes(
+        b"".join(struct.pack("<H", v & 0xFFFF) for v in vals))
+    p = parse_launch(
+        f'tensor_src_iio device=adc base-dir={base} dev-dir={devdir} '
+        'buffer-capacity=2 num-buffers=1 ! appsink name=out')
+    p.run(15)
+    np.testing.assert_allclose(
+        p["out"].buffers[0].chunks[0].host().ravel(), [100.0, -5.0])
+
+
+def test_mixed_storage_alignment(tmp_path):
+    """u8 channel followed by s16: the kernel aligns the 16-bit sample
+    to offset 2 and pads the frame to 4 bytes."""
+    base = tmp_path / "sys"
+    dev = base / "iio:device0"
+    scan = dev / "scan_elements"
+    scan.mkdir(parents=True)
+    (dev / "name").write_text("mixed\n")
+    (scan / "in_a_en").write_text("1")
+    (scan / "in_a_index").write_text("0")
+    (scan / "in_a_type").write_text("le:u8/8>>0")
+    (scan / "in_b_en").write_text("1")
+    (scan / "in_b_index").write_text("1")
+    (scan / "in_b_type").write_text("le:s16/16>>0")
+    devdir = tmp_path / "dev"
+    devdir.mkdir()
+    frames = b""
+    for a, b in ((5, 1000), (7, -1000)):
+        frames += struct.pack("<BxH", a, b & 0xFFFF)  # pad byte at offset 1
+    (devdir / "iio:device0").write_bytes(frames)
+    p = parse_launch(
+        f'tensor_src_iio device=mixed base-dir={base} dev-dir={devdir} '
+        'buffer-capacity=2 num-buffers=1 ! appsink name=out')
+    p.run(15)
+    arr = p["out"].buffers[0].chunks[0].host()
+    np.testing.assert_allclose(arr[:, 0], [5, 7])
+    np.testing.assert_allclose(arr[:, 1], [1000, -1000])
+
+
+def test_oneshot_mode(tmp_path):
+    base, devdir = make_device(tmp_path, [(0, 0)])
+    dev = base / "iio:device0"
+    (dev / "in_accel_x_raw").write_text("42")
+    (dev / "in_accel_y_raw").write_text("-7")
+    p = parse_launch(
+        f'tensor_src_iio device=fake_accel base-dir={base} dev-dir={devdir} '
+        'mode=one-shot num-buffers=1 ! appsink name=out')
+    p.run(15)
+    arr = p["out"].buffers[0].chunks[0].host()
+    np.testing.assert_allclose(arr.ravel(), [21.0, 3.0])  # scale/offset
+
+
+def test_missing_device_errors(tmp_path):
+    (tmp_path / "sys").mkdir()
+    p = parse_launch(
+        f'tensor_src_iio device=nope base-dir={tmp_path / "sys"} ! fakesink')
+    with pytest.raises(ValueError, match="not found"):
+        p.start()
+    p.stop()

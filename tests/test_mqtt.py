@@ -1,0 +1,138 @@
+"""mqttsrc/mqttsink + MqttBroker + SNTP tests (scope ≙ reference
+gst/mqtt elements, ntputil.c, and the base-time synchronization
+documented in synchronization-in-mqtt-elements.md)."""
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+
+from nnstreamer_tpu import Buffer, parse_launch
+from nnstreamer_tpu.edge import MqttBroker, MsgKind, send_msg
+
+CAPS = ('other/tensors,format=static,num_tensors=1,'
+        'types=(string)float32,dimensions=(string)4')
+
+
+def test_pub_sub_round_trip():
+    broker = MqttBroker(port=0).start()
+    sub = parse_launch(
+        f'mqttsrc port={broker.bound_port} sub-topic=edge/cam1 timeout=15 '
+        '! appsink name=out')
+    sub.start()
+    time.sleep(0.2)
+    pub = parse_launch(
+        f'appsrc name=in caps="{CAPS}" '
+        f'! mqttsink pub-topic=edge/cam1 port={broker.bound_port}')
+    pub.start()
+    time.sleep(0.1)
+    for i in range(3):
+        pub["in"].push_buffer(Buffer.from_arrays(
+            [np.full(4, float(i), np.float32)]))
+    deadline = time.monotonic() + 10
+    while len(sub["out"].buffers) < 3 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    pub["in"].end_stream()
+    pub.stop()
+    sub.stop()
+    broker.stop()
+    got = [float(b.chunks[0].host()[0]) for b in sub["out"].buffers]
+    assert got == [0.0, 1.0, 2.0]
+    # caps negotiated from the in-stream header
+    assert sub["out"].sinkpad.caps.to_config().info[0].shape == (4,)
+
+
+def test_two_subscribers_and_wildcard():
+    broker = MqttBroker(port=0).start()
+    s_exact = parse_launch(
+        f'mqttsrc port={broker.bound_port} sub-topic=edge/cam1 timeout=10 '
+        '! appsink name=out')
+    s_wild = parse_launch(
+        f'mqttsrc port={broker.bound_port} sub-topic=edge/# timeout=10 '
+        '! appsink name=out')
+    s_other = parse_launch(
+        f'mqttsrc port={broker.bound_port} sub-topic=other timeout=2 '
+        '! appsink name=out')
+    for s in (s_exact, s_wild, s_other):
+        s.start()
+    time.sleep(0.2)
+    pub = parse_launch(
+        f'appsrc name=in caps="{CAPS}" '
+        f'! mqttsink pub-topic=edge/cam1 port={broker.bound_port}')
+    pub.start()
+    time.sleep(0.1)
+    pub["in"].push_buffer(Buffer.from_arrays([np.ones(4, np.float32)]))
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and (
+            not s_exact["out"].buffers or not s_wild["out"].buffers):
+        time.sleep(0.05)
+    pub["in"].end_stream()
+    pub.stop()
+    for s in (s_exact, s_wild, s_other):
+        s.stop()
+    broker.stop()
+    assert len(s_exact["out"].buffers) == 1
+    assert len(s_wild["out"].buffers) == 1   # '#' wildcard matched
+    assert not s_other["out"].buffers        # topic isolation
+
+
+def test_base_time_retiming():
+    """new_pts = (pub_base_epoch + pts) - sub_base_epoch
+    (≙ synchronization-in-mqtt-elements.md timestamp conversion)."""
+    broker = MqttBroker(port=0).start()
+    sub = parse_launch(
+        f'mqttsrc name=src port={broker.bound_port} sub-topic=t timeout=10 '
+        '! appsink name=out')
+    sub.start()
+    time.sleep(0.2)
+    sub_base = sub["src"]._base_epoch_ns
+    # craft a publisher whose base-time is exactly 5 ms after ours
+    with socket.create_connection(("localhost", broker.bound_port)) as s:
+        arr = np.ones(4, np.float32)
+        send_msg(s, MsgKind.PUBLISH, {
+            "topic": "t", "caps": CAPS,
+            "base_time_epoch_ns": sub_base + 5_000_000,
+            "pts": 100, "duration": None,
+            "tensors": [{"dtype": "float32", "shape": [4]}],
+        }, [arr.tobytes()])
+        deadline = time.monotonic() + 10
+        while not sub["out"].buffers and time.monotonic() < deadline:
+            time.sleep(0.05)
+    sub.stop()
+    broker.stop()
+    assert sub["out"].buffers[0].pts == 5_000_100
+
+
+def test_sntp_query_against_fake_server():
+    """SNTP math against a local server whose clock is +10 s
+    (≙ ntputil.c querying configured servers)."""
+    from nnstreamer_tpu.edge.ntp import query_offset
+    NTP_DELTA = 2208988800
+    srv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    srv.bind(("localhost", 0))
+    port = srv.getsockname()[1]
+
+    def serve_once():
+        data, addr = srv.recvfrom(512)
+        now = time.time() + 10.0  # server clock runs 10 s ahead
+        secs = int(now) + NTP_DELTA
+        frac = int((now % 1.0) * (1 << 32))
+        reply = bytearray(48)
+        reply[0] = (0 << 6) | (4 << 3) | 4   # mode 4 = server
+        reply[32:40] = struct.pack("!II", secs, frac)  # receive ts
+        reply[40:48] = struct.pack("!II", secs, frac)  # transmit ts
+        srv.sendto(bytes(reply), addr)
+
+    t = threading.Thread(target=serve_once, daemon=True)
+    t.start()
+    off = query_offset("localhost", port, timeout=5.0)
+    t.join(5)
+    srv.close()
+    assert abs(off - 10.0) < 0.5
+
+
+def test_ntp_fallback_when_unreachable():
+    from nnstreamer_tpu.edge.ntp import best_offset
+    # unroutable port: falls back to 0 offset (local clock)
+    assert best_offset("localhost:1", timeout=0.2) == 0.0
