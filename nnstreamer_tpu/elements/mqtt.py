@@ -98,8 +98,7 @@ class MqttSink(SinkElement):
 class MqttSrc(SrcElement):
     PROPS = {"host": "localhost", "port": 1883, "sub-topic": "",
              "ntp-sync": False, "ntp-srvs": "pool.ntp.org:123",
-             "ntp-timeout": 2.0, "timeout": 10.0, "is-live": True,
-             "debug": False}
+             "ntp-timeout": 2.0, "timeout": 10.0, "debug": False}
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
@@ -124,6 +123,10 @@ class MqttSrc(SrcElement):
         super().start()
 
     def stop(self) -> None:
+        # order matters: flag the stop BEFORE closing the socket so a
+        # create() racing us re-checks the event instead of touching a
+        # nulled socket
+        self._stop_evt.set()
         ss = self._sock
         self._sock = None
         if ss is not None:
@@ -135,8 +138,11 @@ class MqttSrc(SrcElement):
 
     def create(self) -> Optional[Buffer]:
         while not self._stop_evt.is_set():
+            sock = self._sock
+            if sock is None:
+                return None
             try:
-                kind, meta, payloads = recv_msg(self._sock)
+                kind, meta, payloads = recv_msg(sock)
             except socket.timeout:
                 logger.warning("%s: no message within timeout", self.name)
                 return None

@@ -1,6 +1,7 @@
 """Filter-backend subplugins (L5) and their registry (L2)."""
 from . import (custom, custom_c, jax_backend, llm,  # noqa: F401
-               onnx_backend, tflite_backend)  # (register built-in backends)
+               onnx_backend, tf_backend, tflite_backend,
+               torch_backend)  # (register built-in backends)
 from .base import (Accelerator, FilterEvent, FilterFramework,
                    FilterProperties, InvokeDrop)
 from .custom import register_custom_easy, unregister_custom_easy

@@ -195,6 +195,9 @@ class JaxFilter(FilterFramework):
 
     # -- events -----------------------------------------------------------
     def handle_event(self, event: FilterEvent, data=None) -> bool:
+        if event == FilterEvent.CHECK_HW_AVAILABILITY:
+            from ..utils.hw import is_available
+            return is_available((data or {}).get("hw", "default"))
         if event == FilterEvent.RELOAD_MODEL:
             # Keep serving with old params while the new ones load
             # (≙ is-updatable reload, nnstreamer_plugin_api_filter.h:359-365)

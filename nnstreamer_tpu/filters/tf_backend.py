@@ -1,0 +1,23 @@
+"""tensorflow backend: frozen GraphDef (.pb) models on the XLA path.
+
+≙ ext/nnstreamer/tensor_filter/tensor_filter_tensorflow.cc (TF C API
+session). The graph imports once (interop/tf_graphdef.py) into a
+jittable function — no tensorflow dependency, same engine as every
+other backend.
+"""
+from __future__ import annotations
+
+from .interop_base import ImportedModelFilter
+from .registry import register_filter
+
+
+def _load(path: str):
+    from ..interop import tf_graphdef
+    return tf_graphdef.load(path)
+
+
+@register_filter
+class TFGraphFilter(ImportedModelFilter):
+    NAME = "tensorflow"
+    EXTENSIONS = (".pb",)
+    _load = staticmethod(_load)

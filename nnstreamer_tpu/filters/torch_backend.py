@@ -1,0 +1,118 @@
+"""pytorch backend: TorchScript (.pt) models.
+
+≙ ext/nnstreamer/tensor_filter/tensor_filter_pytorch.cc (TorchScript
+via the libtorch C++ API). Loads with ``torch.jit.load`` and invokes on
+the host CPU — like the reference, this is a compatibility backend for
+models not yet converted to the XLA path (torch has no TPU device in
+this runtime; the jax/tflite/onnx/pb backends own the MXU). Mirroring
+the reference, input dimensions must be given by properties or pushed
+from negotiated caps (TorchScript carries no static shapes); output
+info is probed with one zero-tensor forward at open time when inputs
+are known.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..tensors.info import TensorInfo, TensorsInfo
+from ..tensors.types import TensorType
+from ..utils.log import logger
+from .base import FilterEvent, FilterFramework, FilterProperties
+from .registry import register_alias, register_filter
+
+
+def _have_torch() -> bool:
+    try:
+        import torch  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+@register_filter
+class TorchFilter(FilterFramework):
+    NAME = "pytorch"
+    EXTENSIONS = (".pt", ".pth")
+    AVAILABLE = _have_torch()
+
+    def __init__(self):
+        self._module = None
+        self._in_info: Optional[TensorsInfo] = None
+        self._out_info: Optional[TensorsInfo] = None
+        self._lock = threading.Lock()
+        self._path = ""
+
+    def open(self, props: FilterProperties) -> None:
+        import torch
+        if not props.model_files:
+            raise ValueError("pytorch backend needs a model file")
+        self._path = props.model_files[0]
+        self._module = torch.jit.load(self._path, map_location="cpu")
+        self._module.eval()
+        self._in_info = props.input_info
+        self._out_info = props.output_info
+        if self._in_info is not None and self._out_info is None:
+            self._out_info = self._probe_outputs(self._in_info)
+        logger.info("pytorch backend loaded %s", self._path)
+
+    def close(self) -> None:
+        self._module = None
+
+    def _probe_outputs(self, in_info: TensorsInfo) -> TensorsInfo:
+        import torch
+        zeros = [torch.zeros(tuple(i.shape),
+                             dtype=getattr(torch,
+                                           np.dtype(i.type.np_dtype).name))
+                 for i in in_info]
+        with torch.no_grad():
+            out = self._module(*zeros)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return TensorsInfo(
+            TensorInfo(None, TensorType.from_dtype(
+                np.dtype(str(o.dtype).replace("torch.", ""))),
+                tuple(o.shape))
+            for o in outs)
+
+    def get_model_info(self) -> Tuple[Optional[TensorsInfo],
+                                      Optional[TensorsInfo]]:
+        return self._in_info, self._out_info
+
+    def set_input_info(self, info: TensorsInfo) -> Optional[TensorsInfo]:
+        self._in_info = info
+        self._out_info = self._probe_outputs(info)
+        return self._out_info
+
+    def invoke(self, inputs: Sequence[Any]) -> List[Any]:
+        import torch
+        with self._lock:
+            xs = []
+            for x, info in zip(inputs, self._in_info or ()):
+                arr = np.asarray(x)
+                if tuple(arr.shape) != tuple(info.shape):
+                    arr = arr.reshape(info.shape)
+                xs.append(torch.from_numpy(np.ascontiguousarray(arr)))
+            if not xs:  # no declared info: pass through as-is
+                xs = [torch.from_numpy(np.ascontiguousarray(np.asarray(x)))
+                      for x in inputs]
+            with torch.no_grad():
+                out = self._module(*xs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return [o.numpy() for o in outs]
+
+    def handle_event(self, event: FilterEvent, data=None) -> bool:
+        if event == FilterEvent.RELOAD_MODEL:
+            import torch
+            path = (data or {}).get("model_files", (self._path,))[0]
+            fresh = torch.jit.load(path, map_location="cpu")
+            fresh.eval()
+            with self._lock:
+                self._module = fresh
+                self._path = path
+            return True
+        return False
+
+
+register_alias("torch", "pytorch")

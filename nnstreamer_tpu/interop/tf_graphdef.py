@@ -1,0 +1,338 @@
+"""TensorFlow GraphDef (.pb) importer: frozen graphs lower to one
+jittable JAX function.
+
+≙ ext/nnstreamer/tensor_filter/tensor_filter_tensorflow.cc (the
+reference feeds the .pb to the TF C API and runs a session). Here the
+GraphDef protobuf is walked with the schema-less wire codec
+(interop/protowire.py) — no tensorflow dependency — and each node
+lowers to a jax/lax op, so a frozen graph becomes a single XLA program
+on the MXU like every other backend.
+
+Supported op set mirrors the importer policy of interop/tflite.py:
+common inference ops lower; anything else raises NotImplementedError
+naming the op (fail loud, never silently wrong).
+
+GraphDef wire schema (tensorflow/core/framework/graph.proto):
+  GraphDef.node = 1 (NodeDef)
+  NodeDef: name=1, op=2, input=3 (repeated), device=4, attr=5 (map)
+  map entry: key=1, value=2 (AttrValue)
+  AttrValue: s=2, i=3, f=4, b=5, type=6, shape=7, tensor=8, list=1
+  AttrValue.ListValue: s=2, i=3, f=4, b=5, type=6, shape=7, tensor=8
+  TensorProto: dtype=1, tensor_shape=2, tensor_content=4, float_val=5,
+               int_val=7, int64_val=10 (content preferred; *_val fallback)
+  TensorShapeProto: dim=2 -> Dim: size=1, name=2
+"""
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..tensors.info import TensorInfo, TensorsInfo
+from ..tensors.types import TensorType
+from .protowire import as_f32, decode, packed_varints
+
+# tensorflow DataType enum -> numpy dtype (types.proto)
+_TF_DTYPES = {
+    1: np.float32, 2: np.float64, 3: np.int32, 4: np.uint8, 5: np.int16,
+    6: np.int8, 9: np.int64, 10: np.bool_, 17: np.uint16, 22: np.uint32,
+    23: np.uint64, 19: np.float16,
+}
+
+
+def _signed64(v: int) -> int:
+    """proto int32/int64 negatives ride as 64-bit two's-complement
+    varints (no zigzag outside sint*)."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+@dataclasses.dataclass
+class _Node:
+    name: str
+    op: str
+    inputs: List[str]
+    attrs: Dict[str, Dict[int, list]]  # attr name -> decoded AttrValue
+
+
+@dataclasses.dataclass
+class TFModel:
+    fn: Callable
+    input_info: TensorsInfo
+    output_info: TensorsInfo
+    path: str
+
+
+# -- proto walking -------------------------------------------------------------
+
+def _attr_shape(av: Dict[int, list]) -> Tuple[int, ...]:
+    shp = decode(av[7][0]) if 7 in av else {}
+    dims = []
+    for d in shp.get(2, []):
+        dd = decode(d)
+        size = int(dd.get(1, [0])[0])
+        # int64 may arrive as unsigned varint; -1 (unknown) wraps huge
+        if size > (1 << 62):
+            size = size - (1 << 64)
+        dims.append(size)
+    return tuple(dims)
+
+
+def _attr_tensor(av: Dict[int, list]) -> np.ndarray:
+    tp = decode(av[8][0])
+    dtype = _TF_DTYPES.get(int(tp.get(1, [1])[0]), np.float32)
+    dims: List[int] = []
+    if 2 in tp:
+        shp = decode(tp[2][0])
+        for d in shp.get(2, []):
+            dims.append(int(decode(d).get(1, [0])[0]))
+    if 4 in tp and tp[4][0]:
+        arr = np.frombuffer(tp[4][0], dtype=np.dtype(dtype).newbyteorder("<"))
+    elif 5 in tp:      # float_val (packed or repeated)
+        raw = tp[5][0] if isinstance(tp[5][0], bytes) else None
+        if raw is not None:
+            arr = np.frombuffer(raw, "<f4")
+        else:
+            arr = np.asarray([as_f32(v) for v in tp[5]], np.float32)
+    elif 7 in tp:      # int_val (field 7; 8 is string_val)
+        vals = (packed_varints(tp[7][0]) if isinstance(tp[7][0], bytes)
+                else [int(v) for v in tp[7]])
+        arr = np.asarray([_signed64(v) for v in vals], np.int64) \
+            .astype(np.int32)
+    elif 10 in tp:     # int64_val
+        vals = (packed_varints(tp[10][0]) if isinstance(tp[10][0], bytes)
+                else [int(v) for v in tp[10]])
+        arr = np.asarray([_signed64(v) for v in vals], np.int64)
+    else:
+        arr = np.zeros(0, dtype)
+    arr = arr.astype(dtype)
+    if dims:
+        if arr.size == 1 and int(np.prod(dims)) > 1:
+            arr = np.full(dims, arr.reshape(-1)[0])  # splat scalar
+        arr = arr.reshape(dims)
+    return arr
+
+
+def _parse(data: bytes) -> List[_Node]:
+    g = decode(data)
+    nodes = []
+    for nb in g.get(1, []):
+        nd = decode(nb)
+        attrs: Dict[str, Dict[int, list]] = {}
+        for ab in nd.get(5, []):
+            entry = decode(ab)
+            key = entry.get(1, [b""])[0].decode()
+            attrs[key] = decode(entry.get(2, [b""])[0])
+        nodes.append(_Node(
+            name=nd.get(1, [b""])[0].decode(),
+            op=nd.get(2, [b""])[0].decode(),
+            inputs=[i.decode() for i in nd.get(3, [])],
+            attrs=attrs))
+    return nodes
+
+
+def _canon(ref: str) -> str:
+    """'node:0' -> 'node'; control deps '^node' handled by the caller."""
+    return ref.split(":", 1)[0]
+
+
+# -- lowering ------------------------------------------------------------------
+
+def _pool(x, ksize, strides, padding, reduce_fn, init):
+    import jax.lax as lax
+    return lax.reduce_window(x, init, reduce_fn,
+                             window_dimensions=tuple(ksize),
+                             window_strides=tuple(strides),
+                             padding=padding)
+
+
+class _Lowerer:
+    def __init__(self, nodes: List[_Node]):
+        self.nodes = {n.name: n for n in nodes}
+        self.order = nodes
+
+    def attr_i(self, n: _Node, key: str, default: int = 0) -> int:
+        av = n.attrs.get(key)
+        return int(av[3][0]) if av and 3 in av else default
+
+    def attr_b(self, n: _Node, key: str, default: bool = False) -> bool:
+        av = n.attrs.get(key)
+        return bool(av[5][0]) if av and 5 in av else default
+
+    def attr_f(self, n: _Node, key: str, default: float = 0.0) -> float:
+        av = n.attrs.get(key)
+        return as_f32(av[4][0]) if av and 4 in av else default
+
+    def attr_s(self, n: _Node, key: str, default: str = "") -> str:
+        av = n.attrs.get(key)
+        return av[2][0].decode() if av and 2 in av else default
+
+    def attr_ilist(self, n: _Node, key: str) -> List[int]:
+        av = n.attrs.get(key)
+        if not av or 1 not in av:
+            return []
+        lst = decode(av[1][0])
+        raw = lst.get(3, [])
+        if len(raw) == 1 and isinstance(raw[0], bytes):
+            return [v for v in packed_varints(raw[0])]
+        return [int(v) for v in raw]
+
+    def lower(self, n: _Node, env: Dict[str, Any]):
+        import jax.numpy as jnp
+        import jax.nn
+        import jax.lax as lax
+        ins = [env[_canon(i)] for i in n.inputs if not i.startswith("^")]
+        op = n.op
+        if op in ("Identity", "StopGradient", "PreventGradient", "CheckNumerics"):
+            return ins[0]
+        if op in ("Add", "AddV2"):
+            return ins[0] + ins[1]
+        if op == "Sub":
+            return ins[0] - ins[1]
+        if op == "Mul":
+            return ins[0] * ins[1]
+        if op in ("RealDiv", "Div"):
+            return ins[0] / ins[1]
+        if op == "Maximum":
+            return jnp.maximum(ins[0], ins[1])
+        if op == "Minimum":
+            return jnp.minimum(ins[0], ins[1])
+        if op == "MatMul":
+            a, b = ins
+            if self.attr_b(n, "transpose_a"):
+                a = a.T
+            if self.attr_b(n, "transpose_b"):
+                b = b.T
+            return a @ b
+        if op == "BiasAdd":
+            return ins[0] + ins[1]
+        if op == "Relu":
+            return jax.nn.relu(ins[0])
+        if op == "Relu6":
+            return jnp.clip(ins[0], 0, 6)
+        if op == "Softmax":
+            return jax.nn.softmax(ins[0], axis=-1)
+        if op == "Sigmoid":
+            return jax.nn.sigmoid(ins[0])
+        if op == "Tanh":
+            return jnp.tanh(ins[0])
+        if op == "Sqrt":
+            return jnp.sqrt(ins[0])
+        if op == "Rsqrt":
+            return lax.rsqrt(ins[0])
+        if op == "Exp":
+            return jnp.exp(ins[0])
+        if op == "Neg":
+            return -ins[0]
+        if op == "Square":
+            return ins[0] * ins[0]
+        if op == "Reshape":
+            return jnp.reshape(ins[0], [int(d) for d in
+                                        np.asarray(ins[1]).reshape(-1)])
+        if op == "Squeeze":
+            dims = self.attr_ilist(n, "squeeze_dims") or None
+            return jnp.squeeze(ins[0], axis=tuple(dims) if dims else None)
+        if op == "ExpandDims":
+            return jnp.expand_dims(ins[0], int(np.asarray(ins[1])))
+        if op in ("ConcatV2", "Concat"):
+            if op == "ConcatV2":
+                axis = int(np.asarray(ins[-1]))
+                parts = ins[:-1]
+            else:
+                axis = int(np.asarray(ins[0]))
+                parts = ins[1:]
+            return jnp.concatenate(parts, axis=axis)
+        if op == "Pad":
+            pads = np.asarray(ins[1]).astype(int)
+            return jnp.pad(ins[0], [(int(a), int(b)) for a, b in pads])
+        if op == "Mean":
+            axes = tuple(int(a) for a in np.asarray(ins[1]).reshape(-1))
+            keep = self.attr_b(n, "keep_dims")
+            return jnp.mean(ins[0], axis=axes, keepdims=keep)
+        if op in ("Conv2D", "DepthwiseConv2dNative"):
+            x, w = ins
+            strides = self.attr_ilist(n, "strides") or [1, 1, 1, 1]
+            padding = self.attr_s(n, "padding", "SAME")
+            if self.attr_s(n, "data_format", "NHWC") != "NHWC":
+                raise NotImplementedError("tf import: only NHWC conv")
+            fgc = 1
+            if op == "DepthwiseConv2dNative":
+                # HWIM -> HWI(M) with feature_group_count = in_channels
+                h, wd, cin, mult = w.shape
+                w = w.reshape(h, wd, 1, cin * mult)
+                fgc = cin
+            return lax.conv_general_dilated(
+                x, w, window_strides=tuple(strides[1:3]), padding=padding,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                feature_group_count=fgc)
+        if op in ("MaxPool", "AvgPool"):
+            ksize = self.attr_ilist(n, "ksize") or [1, 1, 1, 1]
+            strides = self.attr_ilist(n, "strides") or [1, 1, 1, 1]
+            padding = self.attr_s(n, "padding", "VALID")
+            if op == "MaxPool":
+                return _pool(ins[0], ksize, strides, padding,
+                             lax.max, -jnp.inf)
+            s = _pool(ins[0], ksize, strides, padding, lax.add, 0.0)
+            ones = jnp.ones_like(ins[0])
+            cnt = _pool(ones, ksize, strides, padding, lax.add, 0.0)
+            return s / cnt
+        if op in ("FusedBatchNorm", "FusedBatchNormV3"):
+            x, scale, offset, mean, var = ins[:5]
+            eps = self.attr_f(n, "epsilon", 1e-3)
+            inv = scale * lax.rsqrt(var + eps)
+            return x * inv + (offset - mean * inv)
+        raise NotImplementedError(
+            f"tf import: unsupported GraphDef op {op!r} (node {n.name!r})")
+
+
+def load(path: str) -> TFModel:
+    with open(path, "rb") as f:
+        nodes = _parse(f.read())
+    if not nodes:
+        raise ValueError(f"{path}: empty or unparsable GraphDef")
+    consts: Dict[str, np.ndarray] = {}
+    placeholders: List[_Node] = []
+    for n in nodes:
+        if n.op == "Const":
+            consts[n.name] = _attr_tensor(n.attrs["value"])
+        elif n.op == "Placeholder":
+            placeholders.append(n)
+    consumed = {_canon(i) for n in nodes for i in n.inputs
+                if not i.startswith("^")}
+    outputs = [n.name for n in nodes
+               if n.name not in consumed and n.op not in ("Const",
+                                                          "Placeholder",
+                                                          "NoOp")]
+    if not outputs:
+        raise ValueError(f"{path}: no output nodes found")
+    lower = _Lowerer(nodes)
+
+    def fn(*inputs):
+        env: Dict[str, Any] = dict(consts)
+        for ph, x in zip(placeholders, inputs):
+            env[ph.name] = x
+        for n in lower.order:
+            if n.op in ("Const", "Placeholder", "NoOp"):
+                continue
+            env[n.name] = lower.lower(n, env)
+        return [env[o] for o in outputs]
+
+    def _ph_info(ph: _Node) -> TensorInfo:
+        dt = _TF_DTYPES.get(int(ph.attrs.get("dtype", {}).get(6, [1])[0]),
+                            np.float32)
+        shape = tuple(1 if d < 0 else d
+                      for d in _attr_shape(ph.attrs.get("shape", {})))
+        return TensorInfo(ph.name, TensorType.from_dtype(np.dtype(dt)),
+                          shape or (1,))
+
+    in_info = TensorsInfo(_ph_info(p) for p in placeholders)
+    # trace output shapes/dtypes without running the graph
+    import jax
+    zeros = [np.zeros(i.shape, i.type.np_dtype) for i in in_info]
+    out_shapes = jax.eval_shape(fn, *zeros)
+    out_info = TensorsInfo(
+        TensorInfo(name, TensorType.from_dtype(s.dtype), tuple(s.shape))
+        for name, s in zip(outputs, out_shapes))
+    return TFModel(fn=fn, input_info=in_info, output_info=out_info,
+                   path=path)

@@ -58,6 +58,11 @@ class Element:
             name = f"{type(self).__name__.lower()}{Element._anon_counter[0]}"
         self.name = name
         self.pipeline = None  # set by Pipeline.add
+        # per-element-kind debug category (≙ GST_DEBUG_CATEGORY per
+        # element; level via NNS_TPU_DEBUG="tensor_filter:DEBUG,...")
+        from ..utils.log import category
+        self.log = category(getattr(type(self), "ELEMENT_NAME",
+                                    type(self).__name__.lower()))
         self.sink_pads: Dict[str, Pad] = {}
         self.src_pads: Dict[str, Pad] = {}
         self._eos_seen: set = set()

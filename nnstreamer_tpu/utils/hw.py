@@ -1,0 +1,83 @@
+"""Hardware capability probe.
+
+≙ gst/nnstreamer/hw_accel.c (NEON/SIMD detection via getauxval) — the
+TPU-native version surfaces the accelerator fleet (jax.devices(): kind,
+count, per-device memory stats) alongside host SIMD flags from
+/proc/cpuinfo, and answers the filter ABI's CHECK_HW_AVAILABILITY
+event.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List
+
+
+@functools.lru_cache(maxsize=1)
+def cpu_simd_flags() -> List[str]:
+    """Host vector-ISA flags (≙ accl_available neon/sse checks)."""
+    wanted = {"neon", "asimd", "sse", "sse2", "sse4_1", "sse4_2",
+              "avx", "avx2", "avx512f", "amx_tile"}
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.lower().startswith(("flags", "features")):
+                    present = set(line.split(":", 1)[1].split())
+                    return sorted(wanted & present)
+    except OSError:
+        pass
+    return []
+
+
+def accelerators() -> List[Dict[str, Any]]:
+    """One entry per jax device: platform/kind/id + memory stats when
+    the backend exposes them (TPU HBM usage)."""
+    import jax
+    out = []
+    for d in jax.devices():
+        entry: Dict[str, Any] = {
+            "id": d.id,
+            "platform": d.platform,
+            "kind": getattr(d, "device_kind", ""),
+            "process_index": d.process_index,
+        }
+        try:
+            stats = d.memory_stats()
+            if stats:
+                entry["memory"] = {
+                    "bytes_in_use": stats.get("bytes_in_use"),
+                    "bytes_limit": stats.get("bytes_limit"),
+                }
+        except Exception:  # noqa: BLE001 -- optional per backend
+            pass
+        out.append(entry)
+    return out
+
+
+def capabilities() -> Dict[str, Any]:
+    """Full probe result; cheap after the first call (jax caches its
+    backend)."""
+    accs = accelerators()
+    return {
+        "accelerators": accs,
+        "num_devices": len(accs),
+        "default_platform": accs[0]["platform"] if accs else "none",
+        "cpu_simd": cpu_simd_flags(),
+    }
+
+
+def is_available(kind: str) -> bool:
+    """CHECK_HW_AVAILABILITY answer: is an accelerator of this kind
+    (``tpu``/``gpu``/``cpu``/``default``) usable?"""
+    import jax
+    kind = (kind or "default").lower()
+    if kind in ("default", "any"):
+        return True
+    if kind in ("cpu", "gpu", "tpu"):
+        # ask the named backend directly: jax.devices() only lists the
+        # default platform, so a TPU host would wrongly report no CPU
+        try:
+            return len(jax.devices(kind)) > 0
+        except RuntimeError:
+            return False
+    return any(a["platform"].lower() == kind or
+               kind in a["kind"].lower() for a in accelerators())

@@ -1,10 +1,20 @@
-"""Framework logging (≙ ml_loge/logw/logi/logd macros,
-ref: gst/nnstreamer/nnstreamer_log.c:35-64 -- error logs there attach a
-backtrace; Python's logging.exception gives us the same for free)."""
+"""Framework logging: leveled categories + backtrace-on-error.
+
+≙ ml_loge/logw/logi/logd + ml_logf_stacktrace
+(gst/nnstreamer/nnstreamer_log.c:35-64) and GStreamer's GST_DEBUG
+per-category levels the reference elements rely on. Categories are
+child loggers (``nnstreamer_tpu.<category>``); per-category levels come
+from ``NNS_TPU_DEBUG``, e.g.::
+
+    NNS_TPU_DEBUG="tensor_filter:DEBUG,mux:INFO,*:WARNING"
+
+The global default level comes from ``NNS_TPU_LOG`` (default WARNING).
+"""
 from __future__ import annotations
 
 import logging
 import os
+from typing import Dict
 
 logger = logging.getLogger("nnstreamer_tpu")
 
@@ -12,6 +22,58 @@ _level = os.environ.get("NNS_TPU_LOG", "WARNING").upper()
 if not logger.handlers:
     handler = logging.StreamHandler()
     handler.setFormatter(logging.Formatter(
-        "%(asctime)s %(levelname).1s nns-tpu %(message)s"))
+        "%(asctime)s %(levelname).1s %(name)s %(message)s"))
     logger.addHandler(handler)
     logger.setLevel(getattr(logging, _level, logging.WARNING))
+
+
+def _parse_debug_spec(spec: str) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        cat, _, lvl = part.partition(":")
+        level = getattr(logging, lvl.strip().upper(), None)
+        if isinstance(level, int):
+            out[cat.strip()] = level
+    return out
+
+
+_debug_spec = _parse_debug_spec(os.environ.get("NNS_TPU_DEBUG", ""))
+
+
+def reload_debug_spec() -> None:
+    """Re-read NNS_TPU_DEBUG (tests / live reconfiguration)."""
+    global _debug_spec
+    _debug_spec = _parse_debug_spec(os.environ.get("NNS_TPU_DEBUG", ""))
+    for name, lg in list(_categories.items()):
+        lg.setLevel(_level_for(name))
+
+
+def _level_for(name: str) -> int:
+    if name in _debug_spec:
+        return _debug_spec[name]
+    if "*" in _debug_spec:
+        return _debug_spec["*"]
+    return logging.NOTSET  # inherit the root framework level
+
+
+_categories: Dict[str, logging.Logger] = {}
+
+
+def category(name: str) -> logging.Logger:
+    """Per-element/per-subsystem debug category (≙ GST_DEBUG_CATEGORY).
+    Same name -> same logger; level governed by NNS_TPU_DEBUG."""
+    lg = _categories.get(name)
+    if lg is None:
+        lg = logger.getChild(name)
+        lg.setLevel(_level_for(name))
+        _categories[name] = lg
+    return lg
+
+
+def error_with_backtrace(lg: logging.Logger, msg: str, *args) -> None:
+    """Error log carrying the current Python stack
+    (≙ ml_logf_stacktrace / GST_ELEMENT_ERROR_BTRACE)."""
+    lg.error(msg, *args, stack_info=True)

@@ -1,0 +1,128 @@
+"""Observability tests: debug categories, backtrace errors, hw probe,
+model URI resolver (scope ≙ reference nnstreamer_log.c, hw_accel.c,
+ml_agent.c)."""
+import logging
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nt
+from nnstreamer_tpu.filters import register_custom_easy
+from nnstreamer_tpu.tensors import TensorsInfo
+
+CAPS = ("other/tensors,format=static,num_tensors=1,types=float32,"
+        "dimensions=8,framerate=0/1")
+
+
+class TestDebugCategories:
+    def test_per_category_level(self, monkeypatch):
+        from nnstreamer_tpu.utils.log import category, reload_debug_spec
+        monkeypatch.setenv("NNS_TPU_DEBUG",
+                           "tensor_filter:DEBUG,tensor_mux:ERROR")
+        reload_debug_spec()
+        assert category("tensor_filter").getEffectiveLevel() == logging.DEBUG
+        assert category("tensor_mux").getEffectiveLevel() == logging.ERROR
+        monkeypatch.delenv("NNS_TPU_DEBUG")
+        reload_debug_spec()
+
+    def test_wildcard(self, monkeypatch):
+        from nnstreamer_tpu.utils.log import category, reload_debug_spec
+        monkeypatch.setenv("NNS_TPU_DEBUG", "*:INFO")
+        reload_debug_spec()
+        assert category("whatever").getEffectiveLevel() == logging.INFO
+        monkeypatch.delenv("NNS_TPU_DEBUG")
+        reload_debug_spec()
+
+    def test_elements_get_category(self):
+        from nnstreamer_tpu.pipeline.registry import make_element
+        el = make_element("tensor_mux")
+        assert el.log.name.endswith("tensor_mux")
+
+    def test_backtrace_on_error(self, caplog):
+        from nnstreamer_tpu.utils.log import (category,
+                                              error_with_backtrace)
+        lg = category("bt-test")
+        with caplog.at_level(logging.ERROR, logger=lg.name):
+            error_with_backtrace(lg, "boom %d", 42)
+        assert "boom 42" in caplog.text
+        assert "Stack (most recent call last)" in caplog.text
+
+
+class TestHwProbe:
+    def test_capabilities_shape(self):
+        from nnstreamer_tpu.utils.hw import capabilities
+        caps = capabilities()
+        assert caps["num_devices"] >= 1
+        assert caps["default_platform"]
+        assert isinstance(caps["cpu_simd"], list)
+        acc = caps["accelerators"][0]
+        assert {"id", "platform", "kind"} <= set(acc)
+
+    def test_check_hw_event(self):
+        from nnstreamer_tpu.filters import FilterEvent, find_filter
+        fw = find_filter("jax")()
+        assert fw.handle_event(FilterEvent.CHECK_HW_AVAILABILITY,
+                               {"hw": "default"})
+        assert not fw.handle_event(FilterEvent.CHECK_HW_AVAILABILITY,
+                                   {"hw": "quantum"})
+
+
+class TestModelResolver:
+    def test_register_and_resolve(self):
+        from nnstreamer_tpu.utils.models import (register_model, resolve,
+                                                 unregister_model)
+        register_model("mymlp", "zoo://mlp?in_dim=8&hidden=4&out_dim=2")
+        try:
+            assert resolve("model://mymlp").startswith("zoo://mlp")
+            assert resolve("mlagent://model/mymlp").startswith("zoo://")
+            assert resolve("/plain/path.tflite") == "/plain/path.tflite"
+            with pytest.raises(ValueError, match="no model"):
+                resolve("model://nope")
+        finally:
+            unregister_model("mymlp")
+
+    def test_versioned(self):
+        from nnstreamer_tpu.utils.models import (register_model, resolve,
+                                                 unregister_model)
+        register_model("net", "/v1.pb", version="1")
+        register_model("net", "/v2.pb", version="2")
+        try:
+            assert resolve("model://net/1") == "/v1.pb"
+            assert resolve("model://net/2") == "/v2.pb"
+            assert resolve("model://net") == "/v2.pb"  # latest wins
+            # removing the version 'latest' points at repoints the alias
+            unregister_model("net", version="2")
+            assert resolve("model://net") == "/v1.pb"
+        finally:
+            unregister_model("net")
+
+    def test_pipeline_uses_model_uri(self):
+        from nnstreamer_tpu.utils.models import (register_model,
+                                                 unregister_model)
+        register_model("double", "passthrough-x2")
+        register_custom_easy(
+            "passthrough-x2", lambda x: x * 2,
+            TensorsInfo.make("float32", "8"),
+            TensorsInfo.make("float32", "8"))
+        try:
+            p = nt.parse_launch(
+                f"tensortestsrc caps={CAPS} num-buffers=1 pattern=ones ! "
+                "tensor_filter framework=custom-easy model=model://double ! "
+                "appsink name=out")
+            p.run(10)
+            np.testing.assert_allclose(p["out"].buffers[0][0].host(), 2.0)
+        finally:
+            unregister_model("double")
+
+    def test_ini_models_section(self, tmp_path, monkeypatch):
+        from nnstreamer_tpu.utils.conf import conf
+        from nnstreamer_tpu.utils.models import resolve
+        ini = tmp_path / "nns.ini"
+        ini.write_text("[models]\nresnet=/opt/models/resnet.tflite\n")
+        monkeypatch.setenv("NNS_TPU_CONF", str(ini))
+        conf.reload()
+        try:
+            assert resolve("model://resnet") == "/opt/models/resnet.tflite"
+        finally:
+            monkeypatch.delenv("NNS_TPU_CONF")
+            conf.reload()

@@ -1,0 +1,197 @@
+"""TensorFlow .pb importer + PyTorch TorchScript backend tests
+(scope ≙ reference tensor_filter_tensorflow.cc / _pytorch.cc suites).
+
+The .pb fixtures are hand-encoded with the protowire helpers — which
+also makes them an independent check of the GraphDef walker.
+"""
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nt
+from nnstreamer_tpu.interop.protowire import enc_bytes, enc_int, enc_str
+
+
+# -- GraphDef construction helpers --------------------------------------------
+
+def attr_type(dtype: int) -> bytes:
+    return enc_int(6, dtype)
+
+
+def attr_shape(dims) -> bytes:
+    shp = b"".join(enc_bytes(2, enc_int(1, d)) for d in dims)
+    return enc_bytes(7, shp)
+
+
+def attr_tensor(arr: np.ndarray, dtype: int) -> bytes:
+    shp = b"".join(enc_bytes(2, enc_int(1, d)) for d in arr.shape)
+    tp = enc_int(1, dtype) + enc_bytes(2, shp) + \
+        enc_bytes(4, np.ascontiguousarray(arr).tobytes())
+    return enc_bytes(8, tp)
+
+
+def attr_b(v: bool) -> bytes:
+    return enc_int(5, 1 if v else 0)
+
+
+def attr_s(s: str) -> bytes:
+    return enc_str(2, s)
+
+
+def attr_ilist(vals) -> bytes:
+    return enc_bytes(1, b"".join(enc_int(3, v) for v in vals))
+
+
+def node(name, op, inputs=(), **attrs) -> bytes:
+    nd = enc_str(1, name) + enc_str(2, op)
+    for i in inputs:
+        nd += enc_str(3, i)
+    for k, v in attrs.items():
+        nd += enc_bytes(5, enc_str(1, k) + enc_bytes(2, v))
+    return enc_bytes(1, nd)
+
+
+def write_graph(path, nodes) -> str:
+    with open(path, "wb") as f:
+        f.write(b"".join(nodes))
+    return str(path)
+
+
+def mlp_graph(tmp_path):
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((4, 3)).astype(np.float32)
+    b = rng.standard_normal(3).astype(np.float32)
+    pb = write_graph(tmp_path / "mlp.pb", [
+        node("x", "Placeholder", dtype=attr_type(1),
+             shape=attr_shape([1, 4])),
+        node("w", "Const", value=attr_tensor(w, 1)),
+        node("b", "Const", value=attr_tensor(b, 1)),
+        node("mm", "MatMul", ["x", "w"]),
+        node("ba", "BiasAdd", ["mm", "b"]),
+        node("out", "Relu", ["ba"]),
+    ])
+    return pb, w, b
+
+
+class TestGraphDefImport:
+    def test_mlp_values(self, tmp_path):
+        from nnstreamer_tpu.interop.tf_graphdef import load
+        pb, w, b = mlp_graph(tmp_path)
+        m = load(pb)
+        assert [tuple(i.shape) for i in m.input_info] == [(1, 4)]
+        assert [tuple(o.shape) for o in m.output_info] == [(1, 3)]
+        x = np.arange(4, dtype=np.float32).reshape(1, 4)
+        out = np.asarray(m.fn(x)[0])
+        np.testing.assert_allclose(out, np.maximum(x @ w + b, 0),
+                                   rtol=1e-5)
+
+    def test_conv_pool_graph(self, tmp_path):
+        from nnstreamer_tpu.interop.tf_graphdef import load
+        rng = np.random.default_rng(1)
+        k = rng.standard_normal((3, 3, 2, 4)).astype(np.float32)
+        pb = write_graph(tmp_path / "conv.pb", [
+            node("x", "Placeholder", dtype=attr_type(1),
+                 shape=attr_shape([1, 8, 8, 2])),
+            node("k", "Const", value=attr_tensor(k, 1)),
+            node("c", "Conv2D", ["x", "k"], strides=attr_ilist([1, 1, 1, 1]),
+                 padding=attr_s("SAME")),
+            node("p", "MaxPool", ["c"], ksize=attr_ilist([1, 2, 2, 1]),
+                 strides=attr_ilist([1, 2, 2, 1]), padding=attr_s("VALID")),
+        ])
+        m = load(pb)
+        assert [tuple(o.shape) for o in m.output_info] == [(1, 4, 4, 4)]
+        x = rng.standard_normal((1, 8, 8, 2)).astype(np.float32)
+        out = np.asarray(m.fn(x)[0])
+        assert out.shape == (1, 4, 4, 4)
+        assert np.isfinite(out).all()
+
+    def test_pipeline_auto_detect(self, tmp_path):
+        pb, w, b = mlp_graph(tmp_path)
+        caps = ('other/tensors,format=static,num_tensors=1,'
+                'types=(string)float32,dimensions=(string)"4:1"')
+        p = nt.parse_launch(
+            f'tensortestsrc caps="{caps}" num-buffers=2 pattern=ones ! '
+            f'tensor_filter model={pb} ! appsink name=out')
+        p.run(30)
+        out = p["out"].buffers
+        assert len(out) == 2
+        expect = np.maximum(np.ones((1, 4), np.float32) @ w + b, 0)
+        np.testing.assert_allclose(out[0].chunks[0].host(), expect,
+                                   rtol=1e-5)
+
+    def test_int_val_const(self, tmp_path):
+        """Reshape whose shape const rides TensorProto.int_val (field 7)
+        rather than tensor_content — how freeze_graph writes small int
+        consts."""
+        from nnstreamer_tpu.interop.tf_graphdef import load
+
+        def attr_tensor_intval(vals):
+            shp = enc_bytes(2, enc_bytes(2, enc_int(1, len(vals))))
+            tp = enc_int(1, 3) + shp  # dtype DT_INT32
+            for v in vals:
+                tp += enc_int(7, v)   # int_val, unpacked
+            return enc_bytes(8, tp)
+
+        pb = write_graph(tmp_path / "rs.pb", [
+            node("x", "Placeholder", dtype=attr_type(1),
+                 shape=attr_shape([2, 6])),
+            node("shape", "Const", value=attr_tensor_intval([3, 4])),
+            node("r", "Reshape", ["x", "shape"]),
+        ])
+        m = load(pb)
+        out = np.asarray(m.fn(np.zeros((2, 6), np.float32))[0])
+        assert out.shape == (3, 4)
+
+    def test_unsupported_op_fails_loud(self, tmp_path):
+        from nnstreamer_tpu.interop.tf_graphdef import load
+        pb = write_graph(tmp_path / "bad.pb", [
+            node("x", "Placeholder", dtype=attr_type(1),
+                 shape=attr_shape([1])),
+            node("y", "FFT", ["x"]),
+        ])
+        with pytest.raises(NotImplementedError, match="FFT"):
+            load(pb)
+
+
+class TestTorchBackend:
+    @pytest.fixture
+    def script_model(self, tmp_path):
+        torch = pytest.importorskip("torch")
+
+        class Net(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.fc = torch.nn.Linear(4, 3)
+
+            def forward(self, x):
+                return torch.relu(self.fc(x))
+
+        net = Net().eval()
+        path = tmp_path / "net.pt"
+        torch.jit.script(net).save(str(path))
+        return str(path), net
+
+    def test_single_invoke(self, script_model):
+        import torch
+        path, net = script_model
+        from nnstreamer_tpu import SingleShot
+        from nnstreamer_tpu.tensors import TensorsInfo
+        # "4:1" strips the trailing padding dim -> model sees shape (4,)
+        with SingleShot(model=path, framework="pytorch",
+                        input_info=TensorsInfo.make("float32", "4")) as s:
+            x = np.arange(4, dtype=np.float32)
+            out = s.invoke([x])[0]
+        with torch.no_grad():
+            expect = net(torch.from_numpy(x)).numpy()
+        np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5)
+
+    def test_pipeline(self, script_model):
+        path, net = script_model
+        caps = ('other/tensors,format=static,num_tensors=1,'
+                'types=(string)float32,dimensions=(string)"4"')
+        p = nt.parse_launch(
+            f'tensortestsrc caps="{caps}" num-buffers=2 pattern=ones ! '
+            f'tensor_filter framework=pytorch model={path} '
+            'input=4 inputtype=float32 ! appsink name=out')
+        p.run(30)
+        assert len(p["out"].buffers) == 2
+        assert p["out"].buffers[0].chunks[0].host().shape == (3,)
