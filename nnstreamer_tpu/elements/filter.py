@@ -60,6 +60,12 @@ class TensorFilter(Element):
         "shared-tensor-filter-key": "",
         "input-combination": "",
         "output-combination": "",
+        # start async device->host copies of outputs at invoke time, so
+        # a downstream host boundary (decoder/serializer) finds the data
+        # already in flight instead of paying the full D2H round-trip
+        # latency per frame. Off by default: chained device-resident
+        # elements should NOT force transfers.
+        "prefetch-host": False,
     }
 
     def __init__(self, name=None, **props):
@@ -260,6 +266,11 @@ class TensorFilter(Element):
         self._record_latency(time.perf_counter_ns() - t0)
         if self._watchdog is not None:
             self._watchdog.feed()
+        if self.prefetch_host:
+            for o in outputs:
+                copy_async = getattr(o, "copy_to_host_async", None)
+                if copy_async is not None:
+                    copy_async()
         out_chunks = self._combine_outputs(buf, outputs)
         self.push(buf.with_chunks(out_chunks))
 

@@ -96,9 +96,13 @@ class MqttSink(SinkElement):
 
 @register_element("mqttsrc")
 class MqttSrc(SrcElement):
+    # is-live: accepted for launch-line compatibility (standard basesrc
+    # prop on the reference's mqttsrc); this source is inherently live —
+    # frames arrive from the broker in real time either way
     PROPS = {"host": "localhost", "port": 1883, "sub-topic": "",
              "ntp-sync": False, "ntp-srvs": "pool.ntp.org:123",
-             "ntp-timeout": 2.0, "timeout": 10.0, "debug": False}
+             "ntp-timeout": 2.0, "timeout": 10.0, "is-live": True,
+             "debug": False}
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)

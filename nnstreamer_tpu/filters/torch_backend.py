@@ -25,11 +25,10 @@ from .registry import register_alias, register_filter
 
 
 def _have_torch() -> bool:
-    try:
-        import torch  # noqa: F401
-        return True
-    except ImportError:
-        return False
+    # find_spec: availability without importing torch (1-3 s / 100s of
+    # MB) at package-import time; the real import happens at open()
+    import importlib.util
+    return importlib.util.find_spec("torch") is not None
 
 
 @register_filter

@@ -32,7 +32,7 @@ import numpy as np
 
 from ..tensors.info import TensorInfo, TensorsInfo
 from ..tensors.types import TensorType
-from .protowire import as_f32, decode, packed_varints
+from .protowire import as_f32, as_sint, decode, packed_varints
 
 # tensorflow DataType enum -> numpy dtype (types.proto)
 _TF_DTYPES = {
@@ -42,10 +42,6 @@ _TF_DTYPES = {
 }
 
 
-def _signed64(v: int) -> int:
-    """proto int32/int64 negatives ride as 64-bit two's-complement
-    varints (no zigzag outside sint*)."""
-    return v - (1 << 64) if v >= (1 << 63) else v
 
 
 @dataclasses.dataclass
@@ -98,12 +94,12 @@ def _attr_tensor(av: Dict[int, list]) -> np.ndarray:
     elif 7 in tp:      # int_val (field 7; 8 is string_val)
         vals = (packed_varints(tp[7][0]) if isinstance(tp[7][0], bytes)
                 else [int(v) for v in tp[7]])
-        arr = np.asarray([_signed64(v) for v in vals], np.int64) \
+        arr = np.asarray([as_sint(v) for v in vals], np.int64) \
             .astype(np.int32)
     elif 10 in tp:     # int64_val
         vals = (packed_varints(tp[10][0]) if isinstance(tp[10][0], bytes)
                 else [int(v) for v in tp[10]])
-        arr = np.asarray([_signed64(v) for v in vals], np.int64)
+        arr = np.asarray([as_sint(v) for v in vals], np.int64)
     else:
         arr = np.zeros(0, dtype)
     arr = arr.astype(dtype)
@@ -256,6 +252,13 @@ class _Lowerer:
             padding = self.attr_s(n, "padding", "SAME")
             if self.attr_s(n, "data_format", "NHWC") != "NHWC":
                 raise NotImplementedError("tf import: only NHWC conv")
+            dil = self.attr_ilist(n, "dilations")
+            if dil and dil != [1, 1, 1, 1]:
+                # fail loud rather than silently computing the
+                # non-atrous variant (importer policy)
+                raise NotImplementedError(
+                    f"tf import: dilated conv not supported "
+                    f"(dilations={dil}, node {n.name!r})")
             fgc = 1
             if op == "DepthwiseConv2dNative":
                 # HWIM -> HWI(M) with feature_group_count = in_channels

@@ -114,10 +114,15 @@ def _build_ssd(width: str = "1.0", num_classes: str = "91",
     dummy = jnp.zeros((1, hw, hw, 3), jnp.bfloat16)
     params = model.init(jax.random.PRNGKey(int(seed)), dummy)
 
-    def apply_fn(p, frame):
+    def apply_one(p, frame):
         x = frame.astype(jnp.bfloat16) / 127.5 - 1.0
         boxes, classes, scores, count = model.apply(p, x[None])
         return boxes, classes, scores, count
+
+    def apply_fn(p, frame):
+        if frame.ndim == 4:  # batched invoke: vmap the per-frame path
+            return jax.vmap(lambda f: apply_one(p, f))(frame)
+        return apply_one(p, frame)
 
     in_info = TensorsInfo.make("uint8", f"3:{hw}:{hw}")
     out_info = TensorsInfo.make(
@@ -147,8 +152,10 @@ def _build_posenet(width: str = "1.0", size: str = "257",
     params = model.init(jax.random.PRNGKey(int(seed)), dummy)
 
     def apply_fn(p, frame):
+        batched = frame.ndim == 4
         x = frame.astype(jnp.bfloat16) / 127.5 - 1.0
-        return model.apply(p, x[None])[0]
+        out = model.apply(p, x if batched else x[None])
+        return out if batched else out[0]
 
     hm = hw // 16 + (1 if hw % 16 else 0)
     in_info = TensorsInfo.make("uint8", f"3:{hw}:{hw}")
@@ -182,16 +189,27 @@ class DeepLabV3(nn.Module):
 
 @register_model("deeplab_v3")
 def _build_deeplab(width: str = "1.0", size: str = "257",
-                   num_classes: str = "21", seed: str = "0"):
+                   num_classes: str = "21", seed: str = "0",
+                   argmax: str = "0"):
+    """``argmax=1`` folds the per-pixel argmax into the XLA program and
+    emits the int32 [H, W] class map instead of [H, W, C] logits — 21x
+    less D2H traffic; image_segment consumes either form (like the
+    tflite deeplab variants that end in ArgMax)."""
     w, hw, nc = float(width), int(size), int(num_classes)
+    want_argmax = argmax not in ("0", "", "false")
     model = DeepLabV3(num_classes=nc, width=w, out_size=hw)
     dummy = jnp.zeros((1, hw, hw, 3), jnp.bfloat16)
     params = model.init(jax.random.PRNGKey(int(seed)), dummy)
 
     def apply_fn(p, frame):
+        batched = frame.ndim == 4
         x = frame.astype(jnp.bfloat16) / 127.5 - 1.0
-        return model.apply(p, x[None])[0]
+        out = model.apply(p, x if batched else x[None])
+        if want_argmax:
+            out = jnp.argmax(out, axis=-1).astype(jnp.int32)
+        return out if batched else out[0]
 
     in_info = TensorsInfo.make("uint8", f"3:{hw}:{hw}")
-    out_info = TensorsInfo.make("float32", f"{nc}:{hw}:{hw}")
+    out_info = TensorsInfo.make("int32", f"{hw}:{hw}") if want_argmax \
+        else TensorsInfo.make("float32", f"{nc}:{hw}:{hw}")
     return apply_fn, params, in_info, out_info

@@ -113,9 +113,12 @@ def _build_mobilenet_v2(width: str = "1.0", num_classes: str = "1001",
     variables = model.init(jax.random.PRNGKey(int(seed)), dummy)
 
     def apply_fn(params, frame):
+        # batch-polymorphic: an HWC frame runs as batch-1; a BHWC stack
+        # (tensor_aggregator batched invoke) runs as one MXU dispatch
+        batched = frame.ndim == 4
         x = frame.astype(jnp.bfloat16) / 127.5 - 1.0
-        logits = model.apply(params, x[None])
-        return logits[0]
+        logits = model.apply(params, x if batched else x[None])
+        return logits if batched else logits[0]
 
     in_info = TensorsInfo.make("uint8", f"3:{hw}:{hw}")
     out_info = TensorsInfo.make("float32", str(nc))

@@ -37,8 +37,11 @@ def unregister_model(name: str, version: Optional[str] = None) -> None:
         # keep the "latest" alias honest: repoint it at a surviving
         # version, or drop it with the last one
         if removed is not None and _registry.get((name, None)) == removed:
-            left = sorted(k[1] for k in _registry
-                          if k[0] == name and k[1] is not None)
+            def _vkey(v: str):
+                # numeric-aware: version "10" outranks "9"
+                return (0, int(v)) if v.isdigit() else (1, v)
+            left = sorted((k[1] for k in _registry
+                           if k[0] == name and k[1] is not None), key=_vkey)
             if left:
                 _registry[(name, None)] = _registry[(name, left[-1])]
             else:

@@ -65,3 +65,30 @@ def test_gpt_decode_matches_forward():
         logits, cache = step(params, cache, tokens[:, i])
     np.testing.assert_allclose(np.asarray(logits), np.asarray(full[:, -1]),
                                rtol=2e-3, atol=2e-3)
+
+
+def test_zoo_models_batch_polymorphic():
+    """A BHWC stack through apply_fn must equal per-frame results stacked
+    (the tensor_aggregator batched-invoke contract, SUPPORTS_BATCH)."""
+    from nnstreamer_tpu.models import zoo
+    rng = np.random.default_rng(0)
+    for name, kwargs in (("mobilenet_v2", {"size": "64"}),
+                         ("posenet", {"size": "65"}),
+                         ("deeplab_v3", {"size": "65"})):
+        apply_fn, params, in_info, _ = zoo.build(name, **kwargs)
+        frames = rng.integers(0, 255, (3,) + tuple(in_info[0].shape),
+                              np.uint8, endpoint=True)
+        batched = np.asarray(jax.jit(apply_fn)(params, frames))
+        singles = np.stack([np.asarray(apply_fn(params, f)) for f in frames])
+        np.testing.assert_allclose(batched, singles, rtol=2e-2, atol=2e-2)
+
+
+def test_zoo_ssd_batch_polymorphic():
+    from nnstreamer_tpu.models import zoo
+    rng = np.random.default_rng(1)
+    apply_fn, params, in_info, _ = zoo.build("ssd_mobilenet_v2",
+                                             size="96", topk="10")
+    frames = rng.integers(0, 255, (2,) + tuple(in_info[0].shape),
+                          np.uint8, endpoint=True)
+    outs = apply_fn(params, frames)
+    assert all(np.asarray(o).shape[0] == 2 for o in outs)
