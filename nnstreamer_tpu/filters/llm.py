@@ -71,9 +71,16 @@ class LlmFilter(FilterFramework):
         def step(params, cache, token):
             return tfm.decode_step(params, cache, token, cfg)
 
+        def pre(params, cache, tokens):
+            return tfm.prefill(params, cache, tokens, cfg)
+
         self._decode = jax.jit(step)
+        self._prefill = jax.jit(pre)
         self._tfm = tfm
         self._stop.clear()
+        # dispatch accounting: prompts of any length must cost ONE
+        # prefill dispatch (≙ llamacpp n_batch), then one per token
+        self.stats = {"prefill_dispatches": 0, "decode_dispatches": 0}
 
     def close(self) -> None:
         self._stop.set()
@@ -100,12 +107,19 @@ class LlmFilter(FilterFramework):
         max_len = int(self._opts.get("max_len",
                                      str(len(prompt) + max_tokens)))
         key = jax.random.PRNGKey(int(self._opts.get("seed", "0")))
-        cache = self._tfm.init_cache(self._cfg, batch=1, max_len=max_len)
-        logits = None
         prompt = prompt.reshape(-1)
-        for t in prompt:
-            logits, cache = self._decode(
-                self._params, cache, jnp.asarray([t], jnp.int32))
+        if len(prompt) > max_len:
+            # fail before dispatch: the jitted cache write would raise an
+            # opaque XLA shape error (≙ llamacpp context-overflow error)
+            raise ValueError(
+                f"llm: prompt length {len(prompt)} exceeds max_len "
+                f"{max_len}; raise custom=max_len:N")
+        cache = self._tfm.init_cache(self._cfg, batch=1, max_len=max_len)
+        # whole prompt in ONE jitted dispatch (batched prefill); the
+        # per-token loop below is generation only
+        logits, cache = self._prefill(
+            self._params, cache, jnp.asarray(prompt[None, :], jnp.int32))
+        self.stats["prefill_dispatches"] += 1
         pos = len(prompt)  # host-side cache index: no per-token device sync
         for i in range(max_tokens):
             if self._stop.is_set():
@@ -120,6 +134,7 @@ class LlmFilter(FilterFramework):
                 return  # nothing left to decode: skip the trailing step
             logits, cache = self._decode(self._params, cache,
                                          tok.astype(jnp.int32))
+            self.stats["decode_dispatches"] += 1
             pos += 1
 
     def invoke(self, inputs: Sequence[Any]) -> List[Any]:

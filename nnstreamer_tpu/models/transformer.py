@@ -131,7 +131,10 @@ def _attention(q, k, v, positions, cfg: GPTConfig):
     return _dense_attention(q, k, v, positions, positions)
 
 
-def block(h, layer, positions, cfg: GPTConfig):
+def block(h, layer, positions, cfg: GPTConfig, return_kv: bool = False):
+    """One transformer block; with ``return_kv`` also hands back the
+    roped K and raw V so prefill can seed a decode cache from the SAME
+    computation (no duplicated block body)."""
     b, s, d = h.shape
     hd, nh = cfg.head_dim, cfg.n_heads
     x = rmsnorm(h, layer["ln1"])
@@ -147,7 +150,8 @@ def block(h, layer, positions, cfg: GPTConfig):
     ff = jax.nn.silu(x @ layer["w1"]) * (x @ layer["w3"])
     ff = _constrain(ff, cfg, (cfg.data_axis, cfg.seq_axis, cfg.model_axis))
     h = h + ff @ layer["w2"]
-    return _constrain(h, cfg, (cfg.data_axis, cfg.seq_axis, None))
+    h = _constrain(h, cfg, (cfg.data_axis, cfg.seq_axis, None))
+    return (h, k, v) if return_kv else h
 
 
 def forward(params, tokens, cfg: GPTConfig):
@@ -178,6 +182,36 @@ def init_cache(cfg: GPTConfig, batch: int, max_len: int) -> Dict[str, Any]:
     shape = (cfg.n_layers, batch, max_len, cfg.n_heads, cfg.head_dim)
     return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype),
             "index": jnp.zeros((), jnp.int32)}
+
+
+def prefill(params, cache, tokens, cfg: GPTConfig):
+    """Whole-prompt prefill in ONE dispatch: tokens [B,T] int32 ->
+    (logits [B,V] for the last position, cache with K/V written at
+    positions 0..T-1 and index=T).
+
+    ≙ llamacpp's n_batch prompt ingestion
+    (tensor_filter_llamacpp.cc:267) — the causal forward runs batched on
+    the MXU instead of T sequential single-token dispatches; the decode
+    loop then continues from the returned cache. Built on the same
+    block() as forward(), so mesh sharding constraints and ring
+    attention apply to prefill too.
+    """
+    b, t = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+    h = jnp.take(params["embed"], tokens, axis=0)
+    h = _constrain(h, cfg, (cfg.data_axis, cfg.seq_axis, None))
+    new_k, new_v = [], []
+    for i, layer in enumerate(params["layers"]):
+        h, k, v = block(h, layer, positions, cfg, return_kv=True)
+        new_k.append(jax.lax.dynamic_update_slice(
+            cache["k"][i], k.astype(cache["k"].dtype), (0, 0, 0, 0)))
+        new_v.append(jax.lax.dynamic_update_slice(
+            cache["v"][i], v.astype(cache["v"].dtype), (0, 0, 0, 0)))
+    h = rmsnorm(h, params["ln_f"])
+    logits = (h[:, -1] @ params["head"]).astype(jnp.float32)
+    cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v),
+             "index": jnp.asarray(t, jnp.int32)}
+    return logits, cache
 
 
 def decode_step(params, cache, token, cfg: GPTConfig):

@@ -75,6 +75,12 @@ class Queue(Element):
         if isinstance(item, Event):
             self._q.put(item)  # events are serialized: never dropped
             return
+        # the queue bypasses Element.chain (no do_chain), so the tracing
+        # hook must fire here explicitly (stats['buffers'] is counted by
+        # the worker on pop — counting here too would double it)
+        tracer = getattr(self.pipeline, "tracer", None)
+        if tracer is not None:
+            tracer.record(self, item)
         if self.leaky == "upstream":
             # GStreamer leaky=upstream: drop the incoming buffer when full
             try:

@@ -151,6 +151,9 @@ class Element:
             self.stats["events"] += 1
             self.handle_event(pad, item)
             return
+        tracer = getattr(self.pipeline, "tracer", None)
+        if tracer is not None:
+            tracer.record(self, item)
         t0 = time.perf_counter_ns()
         try:
             self.do_chain(pad, item)

@@ -56,6 +56,14 @@ class Pipeline:
         self._error: Optional[Exception] = None
         self._lock = threading.Lock()
         self.running = False
+        self.tracer = None  # set by enable_tracing()
+
+    def enable_tracing(self):
+        """Attach a Tracer (≙ GstShark proctime/interlatency/framerate
+        tracers, SURVEY.md §5); returns it for report()."""
+        from ..utils.trace import Tracer
+        self.tracer = Tracer()
+        return self.tracer
 
     # -- graph construction ----------------------------------------------
     def add(self, *elements: Element) -> "Pipeline":

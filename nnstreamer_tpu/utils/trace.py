@@ -1,0 +1,90 @@
+"""Pipeline tracing: proctime / interlatency / framerate per element.
+
+≙ the GstShark tracers the reference leans on (tools/tracing/README.md:
+proctime, interlatency, framerate, queue-level) — but built in, since
+this runtime owns its scheduler. Enable per pipeline::
+
+    tracer = pipeline.enable_tracing()
+    pipeline.run()
+    print(tracer.report())
+
+Semantics:
+  * proctime      — time spent inside each element's chain (already
+                    accumulated in Element.stats; surfaced here)
+  * interlatency  — time from a buffer's FIRST entry into the pipeline
+                    to its arrival at each element (birth stamped in
+                    buffer extras; copies inherit it via copy_meta_from)
+  * framerate     — buffers/sec observed at each element
+  * queue-level   — live fill of each queue element at report time
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict
+
+
+class _Agg:
+    """O(1)-memory running aggregate (sum/max/count/first/last)."""
+
+    __slots__ = ("n", "total", "peak", "first_ts", "last_ts")
+
+    def __init__(self, now: float):
+        self.n = 0
+        self.total = 0
+        self.peak = 0
+        self.first_ts = now
+        self.last_ts = now
+
+
+class Tracer:
+    BIRTH_KEY = "_trace_birth_ns"
+
+    def __init__(self):
+        # per-element aggregates; the lock keeps fan-in elements (mux
+        # fed from several queue threads) from losing counts
+        self._agg: Dict[str, _Agg] = {}
+        self._lock = threading.Lock()
+
+    # called from Element.chain for every buffer when tracing is on
+    def record(self, element, buf) -> None:
+        now_ns = time.perf_counter_ns()
+        birth = buf.extras.get(self.BIRTH_KEY)
+        if birth is None:
+            buf.extras[self.BIRTH_KEY] = birth = now_ns
+        lat = now_ns - birth
+        now = now_ns / 1e9
+        with self._lock:
+            agg = self._agg.get(element.name)
+            if agg is None:
+                agg = self._agg[element.name] = _Agg(now)
+            agg.n += 1
+            agg.total += lat
+            if lat > agg.peak:
+                agg.peak = lat
+            agg.last_ts = now
+
+    def report(self, pipeline=None) -> Dict[str, Dict[str, Any]]:
+        out: Dict[str, Dict[str, Any]] = {}
+        with self._lock:
+            snap = {k: (a.n, a.total, a.peak, a.first_ts, a.last_ts)
+                    for k, a in self._agg.items()}
+        for name, (n, total, peak, first_ts, last_ts) in snap.items():
+            dt = last_ts - first_ts
+            out[name] = {
+                "buffers": n,
+                "interlatency_us_avg": total / n / 1e3 if n else 0.0,
+                "interlatency_us_max": peak / 1e3,
+                "framerate_fps": (n - 1) / dt if n > 1 and dt > 0 else 0.0,
+            }
+        if pipeline is not None:
+            for name, el in pipeline.elements.items():
+                entry = out.setdefault(name, {})
+                st = el.stats
+                if st.get("buffers"):
+                    entry["proctime_us_avg"] = (st["proctime_ns"] /
+                                                st["buffers"] / 1e3)
+                q = getattr(el, "_q", None)
+                if q is not None and hasattr(q, "qsize"):
+                    entry["queue_level"] = q.qsize()
+        return out

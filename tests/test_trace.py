@@ -1,0 +1,45 @@
+"""Tracing tests (≙ GstShark proctime/interlatency/framerate tracers,
+reference tools/tracing/README.md)."""
+import time
+
+import numpy as np
+
+import nnstreamer_tpu as nt
+from nnstreamer_tpu.filters import register_custom_easy
+from nnstreamer_tpu.tensors import TensorsInfo
+
+CAPS = ("other/tensors,format=static,num_tensors=1,types=float32,"
+        "dimensions=8,framerate=0/1")
+
+
+def test_tracer_reports_all_elements():
+    register_custom_easy(
+        "slow10ms", lambda x: (time.sleep(0.01), x)[1],
+        TensorsInfo.make("float32", "8"), TensorsInfo.make("float32", "8"))
+    p = nt.parse_launch(
+        f"tensortestsrc caps={CAPS} num-buffers=5 ! "
+        "queue name=q max-size-buffers=4 ! "
+        "tensor_filter name=f framework=custom-easy model=slow10ms ! "
+        "appsink name=out")
+    tracer = p.enable_tracing()
+    p.run(20)
+    rep = tracer.report(p)
+    assert {"q", "f", "out"} <= set(rep)
+    # interlatency grows downstream: the sink sees the buffer later
+    # than the filter, which sees it later than the queue
+    assert rep["out"]["interlatency_us_avg"] >= \
+        rep["f"]["interlatency_us_avg"] >= rep["q"]["interlatency_us_avg"]
+    # the slow filter dominates: its downstream interlatency >= ~10ms
+    assert rep["out"]["interlatency_us_avg"] >= 9000
+    assert rep["f"]["proctime_us_avg"] >= 9000
+    assert rep["out"]["buffers"] == 5
+    assert rep["out"]["framerate_fps"] > 0
+
+
+def test_tracing_off_by_default_no_overhead_keys():
+    p = nt.parse_launch(
+        f"tensortestsrc caps={CAPS} num-buffers=2 ! appsink name=out")
+    p.run(10)
+    assert p.tracer is None
+    assert not any(k.startswith("_trace") for k in
+                   p["out"].buffers[0].extras)

@@ -123,3 +123,41 @@ def test_trainer_resume_from_checkpoint(tmp_path):
     pipe.stop()
     loss_b = pipe["out"].buffers[-1].chunks[0].host()[0]
     assert loss_b < loss_a  # continued from the saved params
+
+
+def test_trainer_pipeline_on_mesh(tmp_path):
+    """datareposrc -> tensor_trainer on the 8-virtual-device mesh: the
+    sharded train step from parallel/train.py must actually run in the
+    pipeline path, with decreasing loss and params laid out on the mesh
+    (VERDICT r2 item 2 done-criterion)."""
+    import jax
+    data, jpath, _, _ = _write_dataset(tmp_path, n=32)
+    save = tmp_path / "model_out"
+    pipe = parse_launch(
+        f'datareposrc location={data} json={jpath} is-shuffle=false '
+        'epochs=15 '
+        '! tensor_trainer name=t framework=jax '
+        'model-config="zoo://mlp?in_dim=8&hidden=16&out_dim=4&lr=0.05" '
+        f'model-save-path={save} mesh=4x1x2 rules=gpt '
+        'num-training-samples=24 num-validation-samples=8 epochs=15 '
+        'num-inputs=1 num-labels=1 '
+        '! appsink name=out')
+    # run() would stop() (and release the trainer) before we can
+    # inspect the param shardings, so drive the states manually
+    pipe.start()
+    pipe.wait_eos(300)
+    params = pipe["t"].fw.params
+    pipe.stop()
+    stats = pipe["out"].buffers
+    assert len(stats) >= 15
+    first, last = stats[0].chunks[0].host(), stats[-1].chunks[0].host()
+    assert last[0] < first[0]          # loss decreased on the mesh path
+    # the trainer's params must live on mesh devices (not single-device)
+    leaves = jax.tree_util.tree_leaves(params)
+    assert leaves, "no params"
+    shardings = {str(getattr(l, "sharding", None)) for l in leaves}
+    assert any("mesh" in s.lower() or "NamedSharding" in s
+               for s in shardings), shardings
+    devs = {d for l in leaves for d in l.sharding.device_set}
+    assert len(devs) == 8              # laid out across all 8 devices
+    assert (save / "params").exists()
