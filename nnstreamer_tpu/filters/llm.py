@@ -71,8 +71,9 @@ class LlmFilter(FilterFramework):
         def step(params, cache, token):
             return tfm.decode_step(params, cache, token, cfg)
 
-        def pre(params, cache, tokens):
-            return tfm.prefill(params, cache, tokens, cfg)
+        def pre(params, cache, tokens, true_len):
+            return tfm.prefill(params, cache, tokens, cfg,
+                               true_len=true_len)
 
         self._decode = jax.jit(step)
         self._prefill = jax.jit(pre)
@@ -102,25 +103,33 @@ class LlmFilter(FilterFramework):
         import jax
         import jax.numpy as jnp
 
+        prompt = np.asarray(prompt).reshape(-1)
         max_tokens = int(self._opts.get("max_tokens", "16"))
         temperature = float(self._opts.get("temperature", "0"))
         max_len = int(self._opts.get("max_len",
-                                     str(len(prompt) + max_tokens)))
+                                     str(prompt.size + max_tokens)))
         key = jax.random.PRNGKey(int(self._opts.get("seed", "0")))
-        prompt = prompt.reshape(-1)
-        if len(prompt) > max_len:
+        if prompt.size > max_len:
             # fail before dispatch: the jitted cache write would raise an
             # opaque XLA shape error (≙ llamacpp context-overflow error)
             raise ValueError(
-                f"llm: prompt length {len(prompt)} exceeds max_len "
+                f"llm: prompt length {prompt.size} exceeds max_len "
                 f"{max_len}; raise custom=max_len:N")
         cache = self._tfm.init_cache(self._cfg, batch=1, max_len=max_len)
-        # whole prompt in ONE jitted dispatch (batched prefill); the
-        # per-token loop below is generation only
+        # whole prompt in ONE jitted dispatch; prompts pad to
+        # power-of-two buckets so streams of varied lengths compile
+        # O(log max_len) prefill shapes, not one per length
+        bucket = 8
+        while bucket < prompt.size:
+            bucket *= 2
+        bucket = min(bucket, max_len)
+        padded = np.zeros(bucket, np.int32)
+        padded[:prompt.size] = prompt
         logits, cache = self._prefill(
-            self._params, cache, jnp.asarray(prompt[None, :], jnp.int32))
+            self._params, cache, jnp.asarray(padded[None, :]),
+            jnp.asarray(prompt.size, jnp.int32))
         self.stats["prefill_dispatches"] += 1
-        pos = len(prompt)  # host-side cache index: no per-token device sync
+        pos = prompt.size  # host-side cache index: no per-token device sync
         for i in range(max_tokens):
             if self._stop.is_set():
                 return

@@ -184,10 +184,10 @@ def init_cache(cfg: GPTConfig, batch: int, max_len: int) -> Dict[str, Any]:
             "index": jnp.zeros((), jnp.int32)}
 
 
-def prefill(params, cache, tokens, cfg: GPTConfig):
+def prefill(params, cache, tokens, cfg: GPTConfig, true_len=None):
     """Whole-prompt prefill in ONE dispatch: tokens [B,T] int32 ->
-    (logits [B,V] for the last position, cache with K/V written at
-    positions 0..T-1 and index=T).
+    (logits [B,V] for the last real position, cache with K/V written at
+    positions 0..T-1 and index=true_len).
 
     ≙ llamacpp's n_batch prompt ingestion
     (tensor_filter_llamacpp.cc:267) — the causal forward runs batched on
@@ -195,6 +195,14 @@ def prefill(params, cache, tokens, cfg: GPTConfig):
     loop then continues from the returned cache. Built on the same
     block() as forward(), so mesh sharding constraints and ring
     attention apply to prefill too.
+
+    ``true_len`` (a traced int32 scalar <= T) supports length-bucketed
+    padding: callers pad prompts to a few fixed shapes so jit compiles
+    O(log max_len) variants instead of one per prompt length. Padded
+    positions are causal-masked garbage that is never read: logits come
+    from position true_len-1, and the decode loop overwrites padded
+    cache slots (at positions >= true_len) before its validity mask
+    (arange <= pos) can reach them.
     """
     b, t = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
@@ -208,9 +216,13 @@ def prefill(params, cache, tokens, cfg: GPTConfig):
         new_v.append(jax.lax.dynamic_update_slice(
             cache["v"][i], v.astype(cache["v"].dtype), (0, 0, 0, 0)))
     h = rmsnorm(h, params["ln_f"])
-    logits = (h[:, -1] @ params["head"]).astype(jnp.float32)
+    t_eff = jnp.asarray(t if true_len is None else true_len, jnp.int32)
+    h_last = jnp.take_along_axis(
+        h, jnp.full((b, 1, 1), t_eff - 1)
+        .astype(jnp.int32).repeat(h.shape[-1], axis=-1), axis=1)[:, 0]
+    logits = (h_last @ params["head"]).astype(jnp.float32)
     cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v),
-             "index": jnp.asarray(t, jnp.int32)}
+             "index": t_eff}
     return logits, cache
 
 

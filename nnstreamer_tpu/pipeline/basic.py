@@ -19,6 +19,36 @@ from .registry import register_element
 _SENTINEL = object()
 
 
+class _NativeQueueAdapter:
+    """queue.Queue facade over the C++ MPMC ring (csrc/nns_ring.cc) —
+    the native thread-boundary the reference gets from GStreamer's C
+    queue. Waiting happens in native condition variables, off the GIL."""
+
+    def __init__(self, capacity: int):
+        from ..native.lib import NativeRing
+        self._ring = NativeRing(capacity)
+
+    def put(self, item) -> None:
+        self._ring.push(item, -1)
+
+    def put_nowait(self, item) -> None:
+        if not self._ring.push(item, 0):
+            raise _pyqueue.Full
+
+    def get(self, timeout: Optional[float] = None):
+        item = self._ring.pop(-1 if timeout is None else
+                              max(0, int(timeout * 1000)))
+        if item is None:
+            raise _pyqueue.Empty
+        return item
+
+    def get_nowait(self):
+        return self.get(timeout=0)
+
+    def qsize(self) -> int:
+        return len(self._ring)
+
+
 @register_element("queue")
 class Queue(Element):
     """Thread boundary with a bounded buffer queue.
@@ -27,27 +57,49 @@ class Queue(Element):
     (matching gst queue defaults). GStreamer leaky semantics:
     ``leaky=upstream`` drops the incoming buffer when full;
     ``leaky=downstream`` evicts the oldest queued buffer to make room.
+
+    ``backend=auto`` (default) uses the native C++ ring for the common
+    non-leaky case when libnnstpu is built; ``python``/``native`` force
+    one. Leaky modes always use the python queue (eviction needs its
+    internals).
     """
 
     SINK_TEMPLATES = {"sink": None}
     SRC_TEMPLATES = {"src": None}
-    PROPS = {"max-size-buffers": 16, "leaky": "none"}
+    PROPS = {"max-size-buffers": 16, "leaky": "none", "backend": "auto"}
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
-        self._q: _pyqueue.Queue = _pyqueue.Queue(maxsize=max(1, self.max_size_buffers))
+        self._q = self._make_q()
         self._thread: Optional[threading.Thread] = None
         self._running = False
 
+    def _make_q(self):
+        cap = max(1, self.max_size_buffers)
+        if self.backend in ("auto", "native") and self.leaky == "none":
+            from ..native.lib import native_available
+            if native_available():
+                return _NativeQueueAdapter(cap)
+            if self.backend == "native":
+                raise RuntimeError(
+                    f"{self.name}: backend=native but libnnstpu is not "
+                    "built (run `make native`)")
+        elif self.backend == "native":
+            raise ValueError(
+                f"{self.name}: leaky queues need backend=python")
+        return _pyqueue.Queue(maxsize=cap)
+
     def set_property(self, key: str, value) -> None:
         super().set_property(key, value)
-        if key in ("max-size-buffers", "max_size_buffers"):
+        if key.replace("_", "-") in ("max-size-buffers", "leaky", "backend"):
             # properties may be applied after __init__ (launch parser);
-            # resize then — but never once the worker owns the queue
-            if self._running:
+            # rebuild then — but never once the worker owns the queue.
+            # (set_property also fires from Element.__init__ for
+            # constructor kwargs, before our own attrs exist)
+            if getattr(self, "_running", False):
                 raise RuntimeError(
-                    f"{self.name}: cannot resize a running queue")
-            self._q = _pyqueue.Queue(maxsize=max(1, self.max_size_buffers))
+                    f"{self.name}: cannot reconfigure a running queue")
+            self._q = self._make_q()
 
     def start(self) -> None:
         super().start()

@@ -305,6 +305,9 @@ class SrcElement(Element):
                 buf = self.create()
                 if buf is None:
                     break
+                tracer = getattr(self.pipeline, "tracer", None)
+                if tracer is not None:
+                    tracer.stamp(buf)
                 self.srcpad.push(buf)
                 self._pushed += 1
             self.srcpad.push(EosEvent())

@@ -45,13 +45,29 @@ class Tracer:
         # fed from several queue threads) from losing counts
         self._agg: Dict[str, _Agg] = {}
         self._lock = threading.Lock()
+        # last-seen birth per streaming thread: elements that build a
+        # FRESH Buffer (converter, mux, aggregator, decoders) drop the
+        # extras, but their output is pushed synchronously inside the
+        # chain of the buffer that caused it — so the thread's current
+        # birth is the right inheritance. Sources stamp their buffers
+        # explicitly (stamp()), so a root buffer never inherits a
+        # predecessor's birth.
+        self._tls = threading.local()
+
+    def stamp(self, buf) -> None:
+        """Mark a buffer's birth at the source (SrcElement/appsrc)."""
+        buf.extras[self.BIRTH_KEY] = time.perf_counter_ns()
 
     # called from Element.chain for every buffer when tracing is on
     def record(self, element, buf) -> None:
         now_ns = time.perf_counter_ns()
         birth = buf.extras.get(self.BIRTH_KEY)
         if birth is None:
-            buf.extras[self.BIRTH_KEY] = birth = now_ns
+            birth = getattr(self._tls, "birth", None)
+            if birth is None:
+                birth = now_ns
+            buf.extras[self.BIRTH_KEY] = birth
+        self._tls.birth = birth
         lat = now_ns - birth
         now = now_ns / 1e9
         with self._lock:

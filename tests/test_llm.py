@@ -118,3 +118,22 @@ def test_prefill_cache_matches_decode_loop():
     np.testing.assert_allclose(np.asarray(fast_cache["k"]),
                                np.asarray(cache["k"]), rtol=2e-3, atol=2e-3)
     assert int(fast_cache["index"]) == tokens.shape[1]
+
+
+def test_prefill_length_bucketing_reuses_compilation():
+    """Prompts of different lengths within one power-of-two bucket share
+    a single compiled prefill (no per-length recompile), and 2-D
+    prompts are flattened before the overflow check."""
+    from nnstreamer_tpu.filters.base import FilterProperties
+    from nnstreamer_tpu.filters.registry import find_filter
+    fw = find_filter("llm")()
+    fw.open(FilterProperties(model_files=(ZOO,),
+                             custom_properties="max_tokens:3,max_len:32"))
+    for prompt in (np.array([1, 2, 3, 4, 5], np.int32),
+                   np.array([9, 8, 7, 6, 5, 4, 3], np.int32),
+                   np.array([[2, 4, 6, 8, 10, 12]], np.int32)):  # 2-D
+        out = fw.invoke([prompt])
+        assert out[0].shape == (3,)
+    # lengths 5, 7, 6 all pad to the 8-bucket: exactly one compilation
+    assert fw._prefill._cache_size() == 1
+    fw.close()

@@ -1,0 +1,40 @@
+"""Pallas custom-op tests: kernel body exercised via interpret mode on
+the CPU mesh, parity against the jnp oracle (the pattern every ops/
+kernel must ship with)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from nnstreamer_tpu.ops import fused_normalize, normalize_reference
+
+
+@pytest.mark.parametrize("shape", [(224, 224, 3), (8,), (3, 5, 7),
+                                   (64, 1024)])
+def test_kernel_parity_interpret(shape):
+    x = np.random.default_rng(0).integers(0, 255, shape, np.uint8,
+                                          endpoint=True)
+    out = fused_normalize(jnp.asarray(x), force_pallas=True)
+    ref = normalize_reference(jnp.asarray(x), 1 / 127.5, 127.5)
+    assert out.dtype == jnp.bfloat16
+    assert out.shape == tuple(shape)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=1e-2, atol=1e-2)
+
+
+def test_custom_scale_offset_and_dtype():
+    x = np.array([[0, 255], [128, 64]], np.uint8)
+    out = fused_normalize(jnp.asarray(x), scale=2.0, offset=1.0,
+                          dtype=jnp.float32, force_pallas=True)
+    np.testing.assert_allclose(
+        np.asarray(out), (x.astype(np.float32) - 1.0) * 2.0, rtol=1e-6)
+
+
+def test_oracle_fallback_off_tpu():
+    # without force_pallas the CPU path is the oracle itself
+    x = jnp.asarray(np.arange(16, dtype=np.uint8))
+    np.testing.assert_allclose(
+        np.asarray(fused_normalize(x), np.float32),
+        np.asarray(normalize_reference(x, 1 / 127.5, 127.5), np.float32))

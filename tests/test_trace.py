@@ -43,3 +43,26 @@ def test_tracing_off_by_default_no_overhead_keys():
     assert p.tracer is None
     assert not any(k.startswith("_trace") for k in
                    p["out"].buffers[0].extras)
+
+
+def test_interlatency_survives_fresh_buffers():
+    """Elements that build brand-new Buffers (tensor_converter here)
+    must not reset the birth stamp — the sink's interlatency includes
+    everything upstream of them."""
+    register_custom_easy(
+        "slow5ms", lambda x: (time.sleep(0.005), x)[1],
+        TensorsInfo.make("float32", "3:4:2"),
+        TensorsInfo.make("float32", "3:4:2"))
+    p = nt.parse_launch(
+        'videotestsrc num-buffers=4 pattern=smpte '
+        'caps="video/x-raw,format=RGB,width=4,height=2,framerate=30/1" ! '
+        "tensor_converter ! tensor_transform mode=typecast "
+        "option=float32 ! "
+        "tensor_filter framework=custom-easy model=slow5ms ! "
+        "appsink name=out")
+    tracer = p.enable_tracing()
+    p.run(20)
+    rep = tracer.report(p)
+    # converter rebuilds the buffer; without birth inheritance the sink
+    # would report near-zero instead of >= the filter's 5 ms sleep
+    assert rep["out"]["interlatency_us_avg"] >= 4500, rep["out"]
