@@ -104,10 +104,19 @@ class LlmFilter(FilterFramework):
         import jax.numpy as jnp
 
         prompt = np.asarray(prompt).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("llm: empty prompt")
         max_tokens = int(self._opts.get("max_tokens", "16"))
         temperature = float(self._opts.get("temperature", "0"))
-        max_len = int(self._opts.get("max_len",
-                                     str(prompt.size + max_tokens)))
+        # prompts pad to power-of-two buckets so streams of varied
+        # lengths compile O(log max_len) prefill shapes, not one per
+        # length; the DEFAULT max_len is derived from the bucket (not
+        # the raw prompt length) so the cache shape — and with it the
+        # decode-step compilation — is bucket-stable too
+        bucket = 8
+        while bucket < prompt.size:
+            bucket *= 2
+        max_len = int(self._opts.get("max_len", str(bucket + max_tokens)))
         key = jax.random.PRNGKey(int(self._opts.get("seed", "0")))
         if prompt.size > max_len:
             # fail before dispatch: the jitted cache write would raise an
@@ -116,12 +125,6 @@ class LlmFilter(FilterFramework):
                 f"llm: prompt length {prompt.size} exceeds max_len "
                 f"{max_len}; raise custom=max_len:N")
         cache = self._tfm.init_cache(self._cfg, batch=1, max_len=max_len)
-        # whole prompt in ONE jitted dispatch; prompts pad to
-        # power-of-two buckets so streams of varied lengths compile
-        # O(log max_len) prefill shapes, not one per length
-        bucket = 8
-        while bucket < prompt.size:
-            bucket *= 2
         bucket = min(bucket, max_len)
         padded = np.zeros(bucket, np.int32)
         padded[:prompt.size] = prompt

@@ -217,9 +217,9 @@ def prefill(params, cache, tokens, cfg: GPTConfig, true_len=None):
             cache["v"][i], v.astype(cache["v"].dtype), (0, 0, 0, 0)))
     h = rmsnorm(h, params["ln_f"])
     t_eff = jnp.asarray(t if true_len is None else true_len, jnp.int32)
-    h_last = jnp.take_along_axis(
-        h, jnp.full((b, 1, 1), t_eff - 1)
-        .astype(jnp.int32).repeat(h.shape[-1], axis=-1), axis=1)[:, 0]
+    # dynamic index on the seq axis; clamps (never wraps) when out of
+    # range, so a zero-length prompt cannot read the padded tail
+    h_last = jax.lax.dynamic_slice_in_dim(h, t_eff - 1, 1, axis=1)[:, 0]
     logits = (h_last @ params["head"]).astype(jnp.float32)
     cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v),
              "index": t_eff}

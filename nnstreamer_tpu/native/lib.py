@@ -51,6 +51,12 @@ def _try_build() -> bool:
         return False
 
 
+def native_built() -> bool:
+    """True when libnnstpu.so is already on disk — the cheap probe for
+    opportunistic callers that must NOT trigger an on-demand build."""
+    return os.path.exists(_LIB_PATH)
+
+
 def load_native_lib() -> Optional[ctypes.CDLL]:
     global _lib, _tried
     with _lock:

@@ -77,8 +77,13 @@ class Queue(Element):
     def _make_q(self):
         cap = max(1, self.max_size_buffers)
         if self.backend in ("auto", "native") and self.leaky == "none":
-            from ..native.lib import native_available
-            if native_available():
+            from ..native.lib import native_available, native_built
+            # auto must never trigger an on-demand `make native` from a
+            # plain pipeline parse — only use a lib already on disk;
+            # explicit backend=native may build
+            usable = (native_available() if self.backend == "native"
+                      else native_built() and native_available())
+            if usable:
                 return _NativeQueueAdapter(cap)
             if self.backend == "native":
                 raise RuntimeError(
@@ -94,8 +99,10 @@ class Queue(Element):
         if key.replace("_", "-") in ("max-size-buffers", "leaky", "backend"):
             # properties may be applied after __init__ (launch parser);
             # rebuild then — but never once the worker owns the queue.
-            # (set_property also fires from Element.__init__ for
-            # constructor kwargs, before our own attrs exist)
+            # During Element.__init__ (constructor kwargs) _q does not
+            # exist yet: skip — Queue.__init__ builds it exactly once.
+            if "_q" not in self.__dict__:
+                return
             if getattr(self, "_running", False):
                 raise RuntimeError(
                     f"{self.name}: cannot reconfigure a running queue")

@@ -1,0 +1,64 @@
+"""CLI launcher tests (≙ the reference's gst-launch-1.0/gst-inspect
+usage surface — the BASELINE 'gst-launch-equivalent CLI')."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_cli(*args, timeout=120):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "nnstreamer_tpu", *args],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO, env=env)
+
+
+def test_inspect_lists_elements():
+    r = run_cli("--inspect")
+    assert r.returncode == 0
+    names = r.stdout.split()
+    assert "tensor_filter" in names and "tensor_mux" in names
+    assert len(names) >= 50
+
+
+def test_inspect_one_element():
+    r = run_cli("--inspect", "tensor_filter")
+    assert r.returncode == 0
+    assert "framework" in r.stdout
+    assert "model" in r.stdout
+
+
+def test_inspect_unknown_element():
+    r = run_cli("--inspect", "nope_element")
+    assert r.returncode == 1
+
+
+def test_inspect_filters():
+    r = run_cli("--inspect-filters")
+    assert r.returncode == 0
+    assert "tensorflow-lite" in r.stdout
+    assert "jax" in r.stdout
+
+
+def test_launch_pipeline_with_stats():
+    r = run_cli(
+        "--stats",
+        'tensortestsrc caps="other/tensors,format=static,num_tensors=1,'
+        'types=(string)float32,dimensions=(string)8" num-buffers=4 '
+        "! queue ! fakesink", timeout=180)
+    assert r.returncode == 0, r.stderr
+    stats = json.loads(r.stdout)
+    sink = [v for k, v in stats.items() if k.startswith("fakesink")][0]
+    assert sink["buffers"] == 4
+
+
+def test_launch_error_exit_code():
+    r = run_cli(
+        'tensortestsrc caps="other/tensors,format=static,num_tensors=1,'
+        'types=(string)float32,dimensions=(string)8" num-buffers=1 '
+        "! tensor_filter framework=custom-easy model=missing ! fakesink",
+        timeout=180)
+    assert r.returncode != 0

@@ -1,0 +1,117 @@
+#!/usr/bin/env python3
+"""Custom-filter scaffold generator.
+
+≙ tools/development/nnstreamerCodeGenCustomFilter.py in the reference:
+emits a ready-to-build skeleton for a new filter subplugin, in either
+flavor this framework supports:
+
+    python tools/gen_custom_filter.py --lang python my_filter
+    python tools/gen_custom_filter.py --lang c my_filter
+
+The python flavor is a FilterFramework subclass registered via
+@register_filter; the C flavor implements csrc/nns_custom.h and builds
+with the same flags as csrc/custom_*.cc.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+PY_TEMPLATE = '''"""{name}: custom filter backend."""
+import numpy as np
+
+from nnstreamer_tpu.filters.base import FilterFramework, FilterProperties
+from nnstreamer_tpu.filters.registry import register_filter
+from nnstreamer_tpu.tensors import TensorsInfo
+
+
+@register_filter
+class {cls}(FilterFramework):
+    NAME = "{name}"
+    EXTENSIONS = ()          # model extensions to claim for auto-detect
+
+    def open(self, props: FilterProperties) -> None:
+        # load your model from props.model_files here
+        self._in = TensorsInfo.make("float32", "8")
+        self._out = TensorsInfo.make("float32", "8")
+
+    def get_model_info(self):
+        return self._in, self._out
+
+    def invoke(self, inputs):
+        # inputs: list of ndarrays/jax.Arrays matching get_model_info()
+        return [np.asarray(x) for x in inputs]
+
+    def close(self) -> None:
+        pass
+'''
+
+C_TEMPLATE = '''// {name}: custom filter (csrc/nns_custom.h ABI).
+// Build: g++ -O2 -fPIC -shared -std=c++17 -I<repo>/csrc -o {name}.so {name}.cc
+#include <cstring>
+#include "nns_custom.h"
+
+static void *init (const char *custom_props) {{
+  (void) custom_props;
+  static int state = 1;   // your state here
+  return &state;
+}}
+
+static void exit_ (void *priv) {{ (void) priv; }}
+
+static int get_input_dim (void *priv, nns_tensors_info *in) {{
+  (void) priv;
+  in->num = 1;
+  in->info[0].type = NNS_FLOAT32;
+  in->info[0].rank = 1;
+  in->info[0].dims[0] = 8;
+  return 0;
+}}
+
+static int get_output_dim (void *priv, nns_tensors_info *out) {{
+  return get_input_dim (priv, out);
+}}
+
+static int invoke (void *priv, const nns_tensors_info *in_info,
+                   const void *const *in, const nns_tensors_info *out_info,
+                   void *const *out) {{
+  (void) priv; (void) out_info;
+  size_t n = 1;
+  for (uint32_t d = 0; d < in_info->info[0].rank; d++)
+    n *= in_info->info[0].dims[d];
+  memcpy (out[0], in[0], n * sizeof (float));
+  return 0;
+}}
+
+static const nns_custom_filter ops = {{ init, exit_, get_input_dim,
+                                       get_output_dim, nullptr, invoke }};
+
+extern "C" const nns_custom_filter *nns_custom_get (void) {{ return &ops; }}
+'''
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("name")
+    ap.add_argument("--lang", choices=("python", "c"), default="python")
+    ap.add_argument("--out-dir", default=".")
+    args = ap.parse_args()
+    cls = "".join(p.capitalize() for p in args.name.split("_")) + "Filter"
+    if args.lang == "python":
+        path = os.path.join(args.out_dir, f"{args.name}.py")
+        body = PY_TEMPLATE.format(name=args.name, cls=cls)
+    else:
+        path = os.path.join(args.out_dir, f"{args.name}.cc")
+        body = C_TEMPLATE.format(name=args.name)
+    if os.path.exists(path):
+        print(f"refusing to overwrite {path}", file=sys.stderr)
+        return 1
+    with open(path, "w") as f:
+        f.write(body)
+    print(f"wrote {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
