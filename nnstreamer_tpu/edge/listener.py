@@ -57,8 +57,11 @@ class TcpListener:
 
     def _accept_loop(self) -> None:
         while not self.stop_evt.is_set():
+            sock = self._sock  # stop() may null the attribute concurrently
+            if sock is None:
+                return
             try:
-                conn, _ = self._sock.accept()
+                conn, _ = sock.accept()
             except OSError:
                 return
             if self._spawn:

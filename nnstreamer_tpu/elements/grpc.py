@@ -124,7 +124,8 @@ class GrpcSink(SinkElement):
     server."""
 
     PROPS = {"host": "localhost", "port": 55115, "server": True,
-             "blocking": True, "idl": "protobuf", "silent": True}
+             "blocking": True, "idl": "protobuf", "silent": True,
+             "timeout": 10.0}  # seconds to wait for a peer; <=0 = forever
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
@@ -168,26 +169,32 @@ class GrpcSink(SinkElement):
                          cfg.rate_n if cfg else 0,
                          cfg.rate_d if cfg else 1)
         payload = _IDL[self.idl][0](frame)
-        with self._ep.lock:
-            peers = list(self._ep.peers)
+        ep = self._ep  # stop() nulls the attribute while we run
+        if ep is None:
+            return
+        with ep.lock:
+            peers = list(ep.peers)
         if not peers and self.blocking:
             # blocking mode (≙ the reference's 'blocking' sync stream):
-            # wait for a consumer instead of dropping the frame
-            deadline = time.monotonic() + 10.0
-            with self._ep.peers_changed:
-                while not self._ep.stop_evt.is_set():
-                    with self._ep.lock:
-                        peers = list(self._ep.peers)
-                    if peers or time.monotonic() > deadline:
+            # wait for a consumer instead of dropping the frame; the
+            # reference blocks indefinitely — timeout<=0 matches that
+            wait_s = float(self.timeout)
+            deadline = (time.monotonic() + wait_s) if wait_s > 0 else None
+            with ep.peers_changed:
+                while not ep.stop_evt.is_set():
+                    with ep.lock:
+                        peers = list(ep.peers)
+                    if peers or (deadline is not None
+                                 and time.monotonic() > deadline):
                         break
-                    self._ep.peers_changed.wait(timeout=0.1)
+                    ep.peers_changed.wait(timeout=0.1)
         if not peers and not self.silent:
             logger.warning("%s: no connected peer, frame dropped", self.name)
         for conn in peers:
             try:
                 send_msg(conn, MsgKind.DATA, {"idl": self.idl}, [payload])
             except (ConnectionError, OSError):
-                self._ep.drop(conn)
+                ep.drop(conn)
 
 
 @register_element("tensor_src_grpc")
@@ -254,10 +261,14 @@ class GrpcSrc(SrcElement):
         super().stop()
 
     def create(self) -> Optional[Buffer]:
-        deadline = time.monotonic() + self.timeout
+        # timeout<=0 = wait forever, matching the sink's blocking prop
+        wait_s = float(self.timeout)
+        deadline = (time.monotonic() + wait_s) if wait_s > 0 else None
         with self._qcond:
             while not self._queue:
-                if self._stop_evt.is_set() or time.monotonic() > deadline:
+                if self._stop_evt.is_set() or (
+                        deadline is not None
+                        and time.monotonic() > deadline):
                     if not self.silent and not self._stop_evt.is_set():
                         logger.warning("%s: no frame within timeout",
                                        self.name)

@@ -18,7 +18,7 @@ from __future__ import annotations
 import os
 import re
 import time
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -232,7 +232,7 @@ class TensorSrcIio(SrcElement):
         return Caps.from_config(TensorsConfig(infos, rate_n=rate, rate_d=1))
 
     # -- data ---------------------------------------------------------------
-    def _read_frames(self) -> Optional[np.ndarray]:
+    def _read_frames(self) -> Tuple[Optional[np.ndarray], bool]:
         want = self._frame_bytes * int(self.buffer_capacity)
         if self.mode == "one-shot":
             # read instantaneous values from in_<ch>_raw sysfs files
@@ -256,9 +256,10 @@ class TensorSrcIio(SrcElement):
             except (BlockingIOError, ValueError, OSError):
                 chunk = None  # no data yet (nonblocking) or closing
             if not chunk:
-                # a regular file returning EOF with no partial frame is
-                # done; a live device retries until poll-timeout
-                if len(data) == 0 and chunk == b"":
+                # b"" is a true EOF (regular file / closed fifo) — terminal
+                # even mid-frame; None means no data yet (nonblocking
+                # device), so retry until poll-timeout
+                if chunk == b"":
                     return None, False
                 if time.monotonic() > deadline:
                     return None, False

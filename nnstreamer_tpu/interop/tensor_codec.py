@@ -160,7 +160,7 @@ def pack_flexbuf(frame: Frame) -> bytes:
             dims,
             flexbuf._Val(flexbuf.BLOB, blob, inline=False),
         ])
-        entries[f"tensor_#{i}"] = vec
+        entries[f"tensor_{i}"] = vec
     entries["num_tensors"] = flexbuf.val_uint(len(frame.arrays))
     entries["rate_n"] = flexbuf.val_int(frame.rate_n)
     entries["rate_d"] = flexbuf.val_int(frame.rate_d)
@@ -176,7 +176,7 @@ def unpack_flexbuf(data: bytes) -> Frame:
     fmt = m["format"].as_int() if "format" in m else 0
     arrays, names = [], []
     for i in range(n):
-        item = m[f"tensor_#{i}"].as_vector()
+        item = m[f"tensor_{i}"].as_vector()
         names.append(item[0].as_str())
         ttype = item[1].as_int()
         dims = [r.as_int() for r in item[2].as_vector()]

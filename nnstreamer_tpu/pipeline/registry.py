@@ -21,10 +21,16 @@ def register_element(name: str):
     return deco
 
 
-# infrastructure elements the parser creates implicitly (inline caps
-# tokens); restriction covers user-named elements, not these — like the
-# reference, whose allowlist governs nnstreamer elements, not gst core
-_IMPLICIT = frozenset({"capsfilter"})
+# core plumbing elements exempt from the allowlist: the reference's
+# element restriction (enable_element_restriction) governs nnstreamer
+# elements only — gst core elements (queue, tee, appsrc, ...) are never
+# restricted there, so a tensor_*-only allowlist must not break plumbing
+_IMPLICIT = frozenset({
+    "capsfilter", "queue", "tee", "identity", "appsrc", "appsink",
+    "fakesink", "tensortestsrc", "videotestsrc", "audiotestsrc",
+    "filesrc", "filesink", "multifilesrc", "multifilesink",
+    "videoconvert", "videoscale", "pngdec",
+})
 
 
 def make_element(kind: str, name=None, **props):

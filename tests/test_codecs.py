@@ -29,6 +29,20 @@ class TestWireCodecs:
             assert a.dtype == b.dtype
             np.testing.assert_array_equal(a, b)
 
+    def test_flexbuf_reference_key_layout(self):
+        """The reference writes map keys "tensor_%d" (no '#'), ref
+        tensor_converter_flexbuf.cc:123 / tensordec-flexbuf.cc:146 — pin
+        both the parsed key set and the raw key bytes so a self-round-trip
+        regression cannot hide a wire incompatibility."""
+        from nnstreamer_tpu.interop import flexbuf
+        frame = tc.Frame([np.ones(2, np.float32), np.zeros(3, np.uint8)],
+                         ["a", "b"], 30, 1)
+        buf = tc.pack_flexbuf(frame)
+        keys = set(flexbuf.root(buf).as_map())
+        assert {"tensor_0", "tensor_1", "num_tensors",
+                "rate_n", "rate_d", "format"} <= keys
+        assert b"tensor_0\x00" in buf and b"tensor_#0" not in buf
+
     def test_flatbuf_parses_with_independent_reader(self):
         # the writer (interop/flatbuild.py) and reader (interop/flatbuf.py,
         # originally written for TFLite files) are independent
