@@ -60,6 +60,29 @@ def _inspect_filters() -> int:
     return 0
 
 
+def _run_broker(kind: str, port: int, timeout: float | None) -> int:
+    """Run a standalone broker process (the SSAT cross-process pattern:
+    tests launch brokers/servers as real processes, ref:
+    tests/nnstreamer_edge/edge/runTest.sh)."""
+    import time
+    if kind == "mqtt":
+        from .edge.mqtt import MqttBroker
+        broker = MqttBroker(port=port).start()
+    else:
+        from .edge.broker import DiscoveryBroker
+        broker = DiscoveryBroker(port=port).start()
+    print(f"broker {kind} listening on {broker.bound_port}", flush=True)
+    try:
+        deadline = time.monotonic() + timeout if timeout else None
+        while deadline is None or time.monotonic() < deadline:
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        broker.stop()
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m nnstreamer_tpu",
@@ -75,12 +98,20 @@ def main(argv=None) -> int:
                     help="list elements, or one element's properties")
     ap.add_argument("--inspect-filters", action="store_true",
                     help="list filter backends")
+    ap.add_argument("--broker", choices=("mqtt", "discovery"),
+                    help="run a standalone broker instead of a pipeline "
+                         "(mqtt = MQTT 3.1.1 data broker, discovery = "
+                         "query HYBRID registry)")
+    ap.add_argument("--port", type=int, default=0,
+                    help="broker port (0 = ephemeral, printed to stdout)")
     args = ap.parse_args(argv)
 
     if args.inspect is not None:
         return _inspect(args.inspect or None)
     if args.inspect_filters:
         return _inspect_filters()
+    if args.broker:
+        return _run_broker(args.broker, args.port, args.timeout)
     if not args.pipeline:
         ap.print_usage()
         return 2

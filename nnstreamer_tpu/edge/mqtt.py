@@ -1,11 +1,11 @@
-"""In-process MQTT-style message broker for tensor pub/sub.
+"""In-process MQTT 3.1.1 broker for tensor pub/sub.
 
-≙ the external MQTT broker (mosquitto) + Eclipse Paho client the
-reference's gst/mqtt elements talk to (mqttsink.c:29). Carries whole
-messages (caps header + base-time + buffer payload) between publishers
-and subscribers by topic; subscribers attach with SUBSCRIBE, publishers
-push PUBLISH frames, the broker fans out. A trailing ``#`` in a
-subscription matches any topic with that prefix (MQTT wildcard).
+≙ the external MQTT broker (mosquitto) the reference's gst/mqtt elements
+talk to (mqttsink.c:29). Speaks the real MQTT 3.1.1 packet layer
+(edge/mqtt_wire.py) — CONNECT/CONNACK, SUBSCRIBE/SUBACK, PUBLISH qos0
+fan-out, PINGREQ/PINGRESP — so standard clients (Paho, mosquitto_pub/
+sub) interop with it, and the mqttsrc/mqttsink elements can equally be
+pointed at a real mosquitto instead.
 
 Unlike the query DiscoveryBroker (control plane only), this broker is a
 data plane: the tensor bytes flow through it, exactly like raw
@@ -15,27 +15,22 @@ from __future__ import annotations
 
 import socket
 import threading
+from struct import error as struct_error
 from typing import Dict, List, Tuple
 
 from ..utils.log import logger
+from . import mqtt_wire as mw
 from .listener import TcpListener
-from .protocol import MsgKind, recv_msg, send_msg
-
-
-def _topic_matches(sub: str, topic: str) -> bool:
-    if sub.endswith("#"):
-        return topic.startswith(sub[:-1])
-    return sub == topic
 
 
 class MqttBroker:
-    """Minimal topic fan-out broker over the edge framing."""
+    """Minimal MQTT 3.1.1 topic fan-out broker (qos0)."""
 
     def __init__(self, host: str = "localhost", port: int = 0):
         self._listener = TcpListener(host, port, self._conn_loop,
                                      name="mqtt-broker", backlog=64)
         self._lock = threading.Lock()
-        # subscriber conn -> (subscription topics, per-conn send lock)
+        # subscriber conn -> (subscription filters, per-conn send lock)
         self._subs: Dict[socket.socket,
                          Tuple[List[str], threading.Lock]] = {}
 
@@ -60,18 +55,33 @@ class MqttBroker:
 
     def _conn_loop(self, conn: socket.socket) -> None:
         try:
+            ptype, _, _ = mw.read_packet(conn)
+            if ptype != mw.CONNECT:
+                return
+            conn.sendall(mw.connack_packet())
             while not self._listener.stop_evt.is_set():
-                kind, meta, payloads = recv_msg(conn)
-                if kind == MsgKind.SUBSCRIBE:
+                ptype, flags, body = mw.read_packet(conn)
+                if ptype == mw.SUBSCRIBE:
+                    pid, topics = mw.parse_subscribe(body)
                     with self._lock:
-                        topics, lock = self._subs.setdefault(
+                        subs, lock = self._subs.setdefault(
                             conn, ([], threading.Lock()))
-                        topics.append(meta["topic"])
-                elif kind == MsgKind.PUBLISH:
-                    self._fan_out(meta, payloads)
-                else:
+                        subs.extend(topics)
+                    with lock:
+                        conn.sendall(
+                            mw.suback_packet(pid, [0] * len(topics)))
+                elif ptype == mw.PUBLISH:
+                    topic, payload = mw.parse_publish(flags, body)
+                    self._fan_out(topic, payload)
+                elif ptype == mw.PINGREQ:
+                    with self._lock:
+                        entry = self._subs.get(conn)
+                    lock = entry[1] if entry else threading.Lock()
+                    with lock:
+                        conn.sendall(mw.pingresp_packet())
+                elif ptype == mw.DISCONNECT:
                     break
-        except (ConnectionError, OSError, ValueError):
+        except (ConnectionError, OSError, ValueError, struct_error):
             pass
         finally:
             with self._lock:
@@ -81,15 +91,15 @@ class MqttBroker:
             except OSError:
                 pass
 
-    def _fan_out(self, meta: Dict, payloads: List[bytes]) -> None:
-        topic = meta.get("topic", "")
+    def _fan_out(self, topic: str, payload: bytes) -> None:
         with self._lock:
-            targets = [(c, lock) for c, (topics, lock) in self._subs.items()
-                       if any(_topic_matches(t, topic) for t in topics)]
+            targets = [(c, lock) for c, (subs, lock) in self._subs.items()
+                       if any(mw.topic_matches(s, topic) for s in subs)]
+        pkt = mw.publish_packet(topic, payload)
         for conn, lock in targets:
             try:
                 with lock:  # serialize per subscriber, not globally
-                    send_msg(conn, MsgKind.PUBLISH, meta, payloads)
+                    conn.sendall(pkt)
             except (ConnectionError, OSError):
                 with self._lock:
                     self._subs.pop(conn, None)

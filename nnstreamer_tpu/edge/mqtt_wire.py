@@ -1,0 +1,289 @@
+"""MQTT 3.1.1 wire protocol — clean-room packet codec + minimal client.
+
+The reference's mqtt elements speak real MQTT through Eclipse Paho
+against a standard broker (ref: gst/mqtt/mqttsink.c:29 MQTTAsync usage);
+this module implements the needed subset of the MQTT 3.1.1 packet layer
+(CONNECT/CONNACK, SUBSCRIBE/SUBACK, PUBLISH qos0, PINGREQ/PINGRESP,
+DISCONNECT) from the public spec, so mqttsrc/mqttsink interop with
+mosquitto/Paho peers, and the in-process broker (edge/mqtt.py) accepts
+standard clients.
+
+Also provides the reference's tensor-message payload header layout
+(GstMQTTMessageHdr, ref: gst/mqtt/mqttcommon.h:49-63 — a 1024-byte
+prefix carrying num_mems/size_mems[16]/base & sent epoch/duration/dts/
+pts/caps-string) so payloads are byte-compatible with reference
+publishers and subscribers.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import List, Optional, Tuple
+
+# -- packet types (MQTT 3.1.1 §2.2.1) -----------------------------------------
+CONNECT = 0x1
+CONNACK = 0x2
+PUBLISH = 0x3
+SUBSCRIBE = 0x8
+SUBACK = 0x9
+UNSUBSCRIBE = 0xA
+UNSUBACK = 0xB
+PINGREQ = 0xC
+PINGRESP = 0xD
+DISCONNECT = 0xE
+
+CLOCK_TIME_NONE = 2 ** 64 - 1  # ≙ GST_CLOCK_TIME_NONE
+
+# GstMQTTMessageHdr: guint num_mems (+4 pad), gsize size_mems[16],
+# gint64 base/sent epoch (ns), GstClockTime duration/dts/pts,
+# char caps[512]; the union pads the whole struct to 1024 bytes
+# (ref: mqttcommon.h:29-63)
+_HDR_FMT = "<I4x16QqqQQQ512s"
+_HDR_LEN = 1024
+_MAX_NUM_MEMS = 16
+
+
+# -- primitives ---------------------------------------------------------------
+
+def encode_varint(n: int) -> bytes:
+    """Remaining-length encoding (§2.2.3): 7 bits per byte, MSB = more."""
+    if n < 0 or n > 268_435_455:
+        raise ValueError(f"mqtt remaining length out of range: {n}")
+    out = bytearray()
+    while True:
+        n, digit = divmod(n, 128)
+        out.append(digit | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def decode_varint(read) -> int:
+    mult, value = 1, 0
+    for _ in range(4):
+        b = read(1)
+        if not b:
+            raise ConnectionError("mqtt: eof in remaining length")
+        value += (b[0] & 0x7F) * mult
+        if not b[0] & 0x80:
+            return value
+        mult *= 128
+    raise ValueError("mqtt: malformed remaining length")
+
+
+def _utf8(s: str) -> bytes:
+    b = s.encode("utf-8")
+    return struct.pack(">H", len(b)) + b
+
+
+def _packet(ptype: int, flags: int, body: bytes) -> bytes:
+    return bytes([(ptype << 4) | flags]) + encode_varint(len(body)) + body
+
+
+# -- packet builders ----------------------------------------------------------
+
+def connect_packet(client_id: str, keepalive: int = 60,
+                   clean_session: bool = True) -> bytes:
+    flags = 0x02 if clean_session else 0x00
+    body = (_utf8("MQTT") + bytes([4, flags])
+            + struct.pack(">H", keepalive) + _utf8(client_id))
+    return _packet(CONNECT, 0, body)
+
+
+def connack_packet(session_present: bool = False, rc: int = 0) -> bytes:
+    return _packet(CONNACK, 0, bytes([1 if session_present else 0, rc]))
+
+
+def subscribe_packet(packet_id: int, topics: List[str], qos: int = 0) -> bytes:
+    body = struct.pack(">H", packet_id)
+    for t in topics:
+        body += _utf8(t) + bytes([qos])
+    return _packet(SUBSCRIBE, 0x2, body)  # §3.8.1: reserved flags = 0b0010
+
+
+def suback_packet(packet_id: int, rcs: List[int]) -> bytes:
+    return _packet(SUBACK, 0, struct.pack(">H", packet_id) + bytes(rcs))
+
+
+def publish_packet(topic: str, payload: bytes, qos: int = 0,
+                   retain: bool = False) -> bytes:
+    if qos != 0:
+        raise NotImplementedError("qos>0 not supported (reference uses qos0 "
+                                  "default, mqttsink 'qos' prop)")
+    return _packet(PUBLISH, 0x1 if retain else 0, _utf8(topic) + payload)
+
+
+def pingreq_packet() -> bytes:
+    return _packet(PINGREQ, 0, b"")
+
+
+def pingresp_packet() -> bytes:
+    return _packet(PINGRESP, 0, b"")
+
+
+def disconnect_packet() -> bytes:
+    return _packet(DISCONNECT, 0, b"")
+
+
+# -- packet reader ------------------------------------------------------------
+
+def read_packet(sock: socket.socket) -> Tuple[int, int, bytes]:
+    """Read one packet: (type, flags, body). Raises ConnectionError on EOF."""
+    def _read(n: int) -> bytes:
+        data = b""
+        while len(data) < n:
+            chunk = sock.recv(n - len(data))
+            if not chunk:
+                raise ConnectionError("mqtt: connection closed")
+            data += chunk
+        return data
+
+    first = _read(1)[0]
+    length = decode_varint(_read)
+    body = _read(length) if length else b""
+    return first >> 4, first & 0x0F, body
+
+
+def parse_publish(flags: int, body: bytes) -> Tuple[str, bytes]:
+    """(topic, payload) from a PUBLISH body; skips the packet id for
+    qos>0 senders so foreign publishers parse too."""
+    tlen = struct.unpack(">H", body[:2])[0]
+    topic = body[2:2 + tlen].decode("utf-8")
+    off = 2 + tlen
+    qos = (flags >> 1) & 0x3
+    if qos:
+        off += 2  # packet id present only for qos 1/2
+    return topic, body[off:]
+
+
+def parse_subscribe(body: bytes) -> Tuple[int, List[str]]:
+    packet_id = struct.unpack(">H", body[:2])[0]
+    topics, off = [], 2
+    while off < len(body):
+        tlen = struct.unpack(">H", body[off:off + 2])[0]
+        topics.append(body[off + 2:off + 2 + tlen].decode("utf-8"))
+        off += 2 + tlen + 1  # skip requested qos byte
+    return packet_id, topics
+
+
+def topic_matches(sub: str, topic: str) -> bool:
+    """MQTT topic filter match: '+' one level, '#' multi-level tail."""
+    if sub == topic:
+        return True
+    sp, tp = sub.split("/"), topic.split("/")
+    for i, s in enumerate(sp):
+        if s == "#":
+            return True
+        if i >= len(tp) or (s != "+" and s != tp[i]):
+            return False
+    return len(sp) == len(tp)
+
+
+# -- reference payload header (GstMQTTMessageHdr) -----------------------------
+
+def pack_msg_hdr(sizes: List[int], caps: str, base_time_epoch_ns: int,
+                 sent_time_epoch_ns: int, duration: Optional[int],
+                 dts: Optional[int], pts: Optional[int]) -> bytes:
+    if len(sizes) > _MAX_NUM_MEMS:
+        raise ValueError(f"mqtt payload limited to {_MAX_NUM_MEMS} memories "
+                         "(GST_MQTT_MAX_NUM_MEMS)")
+    mems = list(sizes) + [0] * (_MAX_NUM_MEMS - len(sizes))
+    raw = struct.pack(
+        _HDR_FMT, len(sizes), *mems, base_time_epoch_ns, sent_time_epoch_ns,
+        CLOCK_TIME_NONE if duration is None else duration,
+        CLOCK_TIME_NONE if dts is None else dts,
+        CLOCK_TIME_NONE if pts is None else pts,
+        caps.encode("utf-8")[:511])
+    return raw + b"\x00" * (_HDR_LEN - len(raw))
+
+
+def unpack_msg_hdr(data: bytes):
+    """-> (sizes, caps, base_epoch, sent_epoch, duration, dts, pts),
+    payload offset is always 1024."""
+    vals = struct.unpack_from(_HDR_FMT, data)
+    num = vals[0]
+    sizes = list(vals[1:1 + num])
+    base_e, sent_e, duration, dts, pts = vals[17:22]
+    caps = vals[22].split(b"\x00", 1)[0].decode("utf-8", "replace")
+
+    def opt(v):
+        return None if v == CLOCK_TIME_NONE else v
+
+    return sizes, caps, base_e, sent_e, opt(duration), opt(dts), opt(pts)
+
+
+# -- minimal blocking client --------------------------------------------------
+
+class MqttClient:
+    """A tiny synchronous MQTT 3.1.1 client (qos0), good enough for the
+    tensor stream elements: connect, subscribe, publish, recv_publish."""
+
+    # keepalive=0 disables the broker's idle timeout (§3.1.2.10): the
+    # tensor elements have no ping loop, and a sparse publisher must not
+    # be disconnected by a real mosquitto after 1.5x keepalive
+    def __init__(self, host: str, port: int, client_id: str,
+                 timeout: float = 10.0, keepalive: int = 0):
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=timeout)
+        self._send_lock = threading.Lock()
+        self._packet_id = 0
+        self._queued: List[Tuple[str, bytes]] = []
+        try:
+            self._sock.sendall(connect_packet(client_id, keepalive))
+            ptype, _, body = read_packet(self._sock)
+            if ptype != CONNACK or len(body) < 2 or body[1] != 0:
+                raise ConnectionError(
+                    f"mqtt: connect refused (type={ptype}, body={body!r})")
+        except Exception:
+            self._sock.close()
+            raise
+
+    def settimeout(self, t: Optional[float]) -> None:
+        self._sock.settimeout(t)
+
+    def subscribe(self, topic: str) -> None:
+        self._packet_id = (self._packet_id % 0xFFFF) + 1
+        with self._send_lock:
+            self._sock.sendall(subscribe_packet(self._packet_id, [topic]))
+        # the broker may interleave PUBLISHes before SUBACK (it registers
+        # the subscription first); queue them for recv_publish — tolerate
+        # means deliver, not discard
+        while True:
+            ptype, flags, body = read_packet(self._sock)
+            if ptype == SUBACK:
+                if body[2:] and body[2] >= 0x80:
+                    raise ConnectionError(f"mqtt: subscribe refused {body!r}")
+                return
+            if ptype == PUBLISH:
+                self._queued.append(parse_publish(flags, body))
+
+    def recv_publish(self) -> Tuple[str, bytes]:
+        """Block until the next PUBLISH; answers PINGREQ in passing."""
+        if self._queued:
+            return self._queued.pop(0)
+        while True:
+            ptype, flags, body = read_packet(self._sock)
+            if ptype == PUBLISH:
+                return parse_publish(flags, body)
+            if ptype == PINGREQ:
+                with self._send_lock:
+                    self._sock.sendall(pingresp_packet())
+
+    def publish(self, topic: str, payload: bytes) -> None:
+        with self._send_lock:
+            self._sock.sendall(publish_packet(topic, payload))
+
+    def ping(self) -> None:
+        with self._send_lock:
+            self._sock.sendall(pingreq_packet())
+
+    def close(self) -> None:
+        try:
+            with self._send_lock:
+                self._sock.sendall(disconnect_packet())
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -234,8 +234,12 @@ class TensorFilter(Element):
         t0 = time.perf_counter_ns()
         try:
             if self.invoke_async:
+                # ctx rides along with the invoke so each dispatched
+                # output frame inherits ITS prompt's buffer (PTS et al.)
+                # even with several invokes in flight; the template is a
+                # fallback for backends that don't thread ctx through
                 self._async_template = buf
-                self.fw.invoke_async(inputs)
+                self.fw.invoke_async(inputs, ctx=buf)
                 self._record_latency(time.perf_counter_ns() - t0)
                 return
             outputs = self.fw.invoke(inputs)
@@ -315,10 +319,15 @@ class TensorFilter(Element):
             chunks.append(inbuf.chunks[idx] if kind == "i" else Chunk(outputs[idx]))
         return chunks
 
-    def _dispatch_async(self, outputs: List[Any]) -> None:
+    def _dispatch_async(self, outputs: List[Any],
+                        ctx: Optional[Buffer] = None) -> None:
         """Called by the backend once per generated output frame
-        (≙ gst_tensor_filter_async_output_callback, tensor_filter.c:1099)."""
-        template = getattr(self, "_async_template", None)
+        (≙ gst_tensor_filter_async_output_callback, tensor_filter.c:1099).
+        ``ctx`` is the input buffer passed at invoke time — with two
+        prompts in flight each token frame is stamped from its OWN
+        prompt, not whichever arrived last."""
+        template = ctx if ctx is not None \
+            else getattr(self, "_async_template", None)
         buf = Buffer([Chunk(o) for o in outputs],
                      pts=template.pts if template else None)
         self.push(buf)

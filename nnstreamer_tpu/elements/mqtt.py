@@ -1,20 +1,22 @@
-"""mqttsink / mqttsrc — tensor streams over a message broker, with
-cross-device base-time synchronization.
+"""mqttsink / mqttsrc — tensor streams over a real MQTT 3.1.1 broker,
+with cross-device base-time synchronization.
 
-≙ gst/mqtt/mqttsink.c + mqttsrc.c (GstBuffer over Paho MQTT): each
-published message carries the caps string plus the publisher pipeline's
-base-time converted to epoch time; the subscriber re-times buffers into
-its own clock domain:
+≙ gst/mqtt/mqttsink.c + mqttsrc.c (GstBuffer over Paho MQTT): the
+transport is the actual MQTT wire protocol (edge/mqtt_wire.py), so these
+elements interop with mosquitto or any standard broker — the in-process
+MqttBroker (edge/mqtt.py) is just a convenient one. Each PUBLISH payload
+is the reference's GstMQTTMessageHdr layout (mqttcommon.h:49-63): a
+1024-byte header carrying num_mems/size_mems/base & sent epoch (ns)/
+duration/dts/pts/caps-string, followed by the raw tensor memories — so
+payloads are byte-compatible with reference publishers/subscribers.
 
-    abs_ts  = pub_base_time_epoch + pts          (publisher side)
-    new_pts = abs_ts - sub_base_time_epoch        (subscriber side)
+Re-timing (ref: Documentation/synchronization-in-mqtt-elements.md):
 
-(ref: Documentation/synchronization-in-mqtt-elements.md). With
-``ntp-sync=true`` the base-time epoch is taken from the configured NTP
+    buf.pts = hdr.pts + (hdr.base_time_epoch - sub.base_time_epoch)
+
+With ``ntp-sync=true`` the base-time epoch comes from the configured NTP
 servers (``ntp-srvs``, ≙ mqtt-ntp-sync/mqtt-ntp-srvs + ntputil.c)
 instead of the local clock, so devices whose clocks drift still agree.
-The broker is edge/mqtt.py's MqttBroker (or anything speaking the same
-framing).
 """
 from __future__ import annotations
 
@@ -23,13 +25,14 @@ import threading
 import time
 from typing import Optional
 
+import numpy as np
+
+from ..edge import mqtt_wire as mw
 from ..edge.ntp import synced_epoch_ns
-from ..edge.protocol import (MsgKind, buffer_to_wire, recv_msg, send_msg,
-                             wire_to_buffer)
 from ..pipeline.element import SinkElement, SrcElement
 from ..pipeline.pad import Pad
 from ..pipeline.registry import register_element
-from ..tensors.buffer import Buffer
+from ..tensors.buffer import Buffer, Chunk
 from ..tensors.caps import Caps
 from ..utils.log import logger
 
@@ -37,13 +40,13 @@ from ..utils.log import logger
 @register_element("mqttsink")
 class MqttSink(SinkElement):
     PROPS = {"host": "localhost", "port": 1883, "pub-topic": "",
-             "ntp-sync": False, "ntp-srvs": "pool.ntp.org:123",
-             "ntp-timeout": 2.0, "debug": False}
+             "client-id": "", "ntp-sync": False,
+             "ntp-srvs": "pool.ntp.org:123", "ntp-timeout": 2.0,
+             "debug": False}
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
-        self._sock: Optional[socket.socket] = None
-        self._send_lock = threading.Lock()
+        self._client: Optional[mw.MqttClient] = None
         self._caps_str = ""
         self._base_epoch_ns = 0
         self._base_mono_ns = 0
@@ -56,16 +59,14 @@ class MqttSink(SinkElement):
         self._base_epoch_ns = synced_epoch_ns(
             self.ntp_srvs if self.ntp_sync else None, self.ntp_timeout)
         self._base_mono_ns = time.monotonic_ns()
-        self._sock = socket.create_connection((self.host, int(self.port)),
-                                              timeout=10.0)
+        self._client = mw.MqttClient(
+            self.host, int(self.port),
+            self.client_id or f"nns-tpu-sink-{id(self):x}")
 
     def stop(self) -> None:
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            except OSError:
-                pass
-            self._sock = None
+        if self._client is not None:
+            self._client.close()
+            self._client = None
         super().stop()
 
     def on_sink_caps(self, pad: Pad, caps: Caps) -> None:
@@ -80,18 +81,25 @@ class MqttSink(SinkElement):
         super().handle_event(pad, event)
 
     def render(self, buf: Buffer) -> None:
-        meta, payloads = buffer_to_wire(buf)
-        meta["topic"] = self.pub_topic
-        meta["caps"] = self._caps_str
-        meta["base_time_epoch_ns"] = self._base_epoch_ns
-        if buf.pts is None:
+        client = self._client
+        if client is None:
+            return
+        mems = [np.ascontiguousarray(c.host()).tobytes() for c in buf.chunks]
+        pts = buf.pts
+        if pts is None:
             # no timestamp: synthesize the running time at publish
-            meta["pts"] = time.monotonic_ns() - self._base_mono_ns
-        with self._send_lock:
-            send_msg(self._sock, MsgKind.PUBLISH, meta, payloads)
+            pts = time.monotonic_ns() - self._base_mono_ns
+        # sent-time derives from the start() epoch + monotonic delta: one
+        # NTP exchange per element lifetime, none on the streaming path
+        sent_epoch = self._base_epoch_ns + (
+            time.monotonic_ns() - self._base_mono_ns)
+        hdr = mw.pack_msg_hdr([len(m) for m in mems], self._caps_str,
+                              self._base_epoch_ns, sent_epoch,
+                              buf.duration, buf.dts, pts)
+        client.publish(self.pub_topic, hdr + b"".join(mems))
         if self.debug:
-            logger.info("%s: published pts=%s to %s", self.name,
-                        meta["pts"], self.pub_topic)
+            logger.info("%s: published pts=%s to %s", self.name, pts,
+                        self.pub_topic)
 
 
 @register_element("mqttsrc")
@@ -100,15 +108,16 @@ class MqttSrc(SrcElement):
     # prop on the reference's mqttsrc); this source is inherently live —
     # frames arrive from the broker in real time either way
     PROPS = {"host": "localhost", "port": 1883, "sub-topic": "",
-             "ntp-sync": False, "ntp-srvs": "pool.ntp.org:123",
-             "ntp-timeout": 2.0, "timeout": 10.0, "is-live": True,
-             "debug": False}
+             "client-id": "", "ntp-sync": False,
+             "ntp-srvs": "pool.ntp.org:123", "ntp-timeout": 2.0,
+             "timeout": 10.0, "is-live": True, "debug": False}
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
-        self._sock: Optional[socket.socket] = None
+        self._client: Optional[mw.MqttClient] = None
         self._base_epoch_ns = 0
         self._caps_sent = False
+        self._caps_cache: tuple = ("", None, None)  # (str, Caps, infos)
 
     def negotiate_src_caps(self) -> Optional[Caps]:
         # caps arrive with the first message; negotiated in-stream
@@ -119,50 +128,71 @@ class MqttSrc(SrcElement):
             raise ValueError(f"{self.name}: 'sub-topic' is required")
         self._base_epoch_ns = synced_epoch_ns(
             self.ntp_srvs if self.ntp_sync else None, self.ntp_timeout)
-        self._sock = socket.create_connection((self.host, int(self.port)),
-                                              timeout=self.timeout)
-        self._sock.settimeout(self.timeout)
-        send_msg(self._sock, MsgKind.SUBSCRIBE, {"topic": self.sub_topic})
+        self._client = mw.MqttClient(
+            self.host, int(self.port),
+            self.client_id or f"nns-tpu-src-{id(self):x}",
+            timeout=self.timeout)
+        self._client.settimeout(self.timeout)
+        self._client.subscribe(self.sub_topic)
         self._caps_sent = False
         super().start()
 
     def stop(self) -> None:
         # order matters: flag the stop BEFORE closing the socket so a
         # create() racing us re-checks the event instead of touching a
-        # nulled socket
+        # nulled client
         self._stop_evt.set()
-        ss = self._sock
-        self._sock = None
-        if ss is not None:
-            try:
-                ss.close()
-            except OSError:
-                pass
+        client = self._client
+        self._client = None
+        if client is not None:
+            client.close()
         super().stop()
 
     def create(self) -> Optional[Buffer]:
         while not self._stop_evt.is_set():
-            sock = self._sock
-            if sock is None:
+            client = self._client
+            if client is None:
                 return None
             try:
-                kind, meta, payloads = recv_msg(sock)
+                _topic, payload = client.recv_publish()
             except socket.timeout:
                 logger.warning("%s: no message within timeout", self.name)
                 return None
-            except (ConnectionError, OSError):
+            except (ConnectionError, OSError, ValueError):
                 return None
-            if kind != MsgKind.PUBLISH:
+            if len(payload) < 1024:
+                logger.warning("%s: short mqtt payload dropped", self.name)
                 continue
-            if not self._caps_sent and meta.get("caps"):
-                self.set_src_caps(Caps(meta["caps"]))
+            sizes, caps_str, pub_base, _sent, duration, dts, pts = \
+                mw.unpack_msg_hdr(payload)
+            # the caps string repeats verbatim frame after frame: parse
+            # once and reuse off the hot path
+            if caps_str and caps_str == self._caps_cache[0]:
+                caps, infos = self._caps_cache[1], self._caps_cache[2]
+            elif caps_str:
+                caps = Caps(caps_str)
+                infos = caps.to_config().info
+                self._caps_cache = (caps_str, caps, infos)
+            else:
+                caps, infos = None, None
+            if not self._caps_sent and caps is not None:
+                self.set_src_caps(caps)
                 self._caps_sent = True
-            buf = wire_to_buffer(meta, payloads)
+            chunks, off = [], 1024
+            for i, sz in enumerate(sizes):
+                raw = payload[off:off + sz]
+                off += sz
+                if infos is not None and i < len(infos):
+                    arr = np.frombuffer(
+                        raw, dtype=infos[i].type.np_dtype
+                    ).reshape(infos[i].shape)
+                else:
+                    arr = np.frombuffer(raw, np.uint8)
+                chunks.append(Chunk(arr))
+            buf = Buffer(chunks, pts=pts, dts=dts, duration=duration)
             # re-time into this pipeline's clock domain (see module doc)
-            pub_base = meta.get("base_time_epoch_ns")
-            if buf.pts is not None and pub_base is not None:
-                abs_ts = pub_base + buf.pts
-                buf.pts = max(0, abs_ts - self._base_epoch_ns)
+            if buf.pts is not None and pub_base:
+                buf.pts = max(0, buf.pts + (pub_base - self._base_epoch_ns))
             if self.debug:
                 logger.info("%s: received pts=%s", self.name, buf.pts)
             return buf

@@ -17,12 +17,14 @@ import threading
 import time
 from typing import Dict, Optional, Tuple
 
+import numpy as np
+
 from ..edge.protocol import (MsgKind, buffer_to_wire, recv_msg, send_msg,
                              wire_to_buffer)
 from ..pipeline.element import Element, SinkElement, SrcElement
 from ..pipeline.pad import Pad
 from ..pipeline.registry import register_element
-from ..tensors.buffer import Buffer
+from ..tensors.buffer import Buffer, Chunk
 from ..tensors.caps import Caps
 from ..utils.log import logger
 
@@ -86,7 +88,15 @@ class TensorQueryServerSrc(SrcElement):
              # broker at dest-host:dest-port (≙ connect-type enum,
              # tensor_query_common.c:30-40)
              "connect-type": "TCP", "topic": "",
-             "dest-host": "localhost", "dest-port": 0}
+             "dest-host": "localhost", "dest-port": 0,
+             # batch>1 = server-side micro-batching: stack up to `batch`
+             # in-flight frames (across ALL clients) into one buffer with
+             # a leading batch dim, padded to a fixed size so the filter
+             # compiles ONE executable; the serversink demuxes rows back
+             # to their clients. BASELINE config 5's "batched invoke over
+             # ICI": the MXU amortizes the dispatch, one D2H ships every
+             # client's result.
+             "batch": 0}
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
@@ -203,7 +213,39 @@ class TensorQueryServerSrc(SrcElement):
                 if self._stop_evt.is_set():
                     return None
                 self._qlock.wait(timeout=0.1)
-            return self._queue.pop(0)
+            k = int(self.batch)
+            if k <= 1:
+                return self._queue.pop(0)
+            bufs = [self._queue.pop(0)]
+            # stop at a shape mismatch: heterogeneous clients still work,
+            # the mismatching frame just opens the next micro-batch
+            while (self._queue and len(bufs) < k
+                   and self._stackable(bufs[0], self._queue[0])):
+                bufs.append(self._queue.pop(0))
+        return self._stack(bufs, k)
+
+    @staticmethod
+    def _stackable(a: Buffer, b: Buffer) -> bool:
+        return (len(a.chunks) == len(b.chunks)
+                and all(x.shape == y.shape and x.dtype == y.dtype
+                        for x, y in zip(a.chunks, b.chunks)))
+
+    def _stack(self, bufs, k: int) -> Buffer:
+        """Stack frames into one leading-dim-``k`` buffer (short batches
+        pad by repeating the last frame — one compiled signature, and on
+        the MXU a padded row is nearly free next to a second dispatch).
+        ``batch_rows`` extras carry each real row's reply route."""
+        rows = bufs + [bufs[-1]] * (k - len(bufs))
+        chunks = []
+        for j in range(len(bufs[0].chunks)):
+            chunks.append(Chunk(np.stack([b.chunks[j].host()
+                                          for b in rows])))
+        out = Buffer(chunks, pts=bufs[0].pts)
+        out.extras["server_id"] = self.id
+        out.extras["batch_rows"] = [
+            (b.extras.get("client_id"), b.extras.get("server_id", self.id),
+             b.pts) for b in bufs]
+        return out
 
 
 @register_element("tensor_query_serversink")
@@ -224,8 +266,21 @@ class TensorQueryServerSink(SinkElement):
         super().handle_event(pad, event)
 
     def render(self, buf: Buffer) -> None:
-        cid = buf.extras.get("client_id")
-        sid = buf.extras.get("server_id", self.id)
+        rows = buf.extras.get("batch_rows")
+        if rows is not None:
+            # micro-batched frame: one D2H of the stacked outputs, then
+            # row i goes back to the client that sent frame i (padded
+            # rows have no entry and are simply dropped)
+            hosts = [c.host() for c in buf.chunks]
+            for i, (cid, sid, pts) in enumerate(rows):
+                row = Buffer([Chunk(np.ascontiguousarray(h[i]))
+                              for h in hosts], pts=pts)
+                self._send_one(row, cid, sid)
+            return
+        self._send_one(buf, buf.extras.get("client_id"),
+                       buf.extras.get("server_id", self.id))
+
+    def _send_one(self, buf: Buffer, cid, sid) -> None:
         conn = SERVER_TABLE.get_conn(sid, cid) if cid is not None else None
         if conn is None:
             logger.warning("%s: no connection for client %s", self.name, cid)

@@ -147,11 +147,19 @@ class FilterFramework:
         return False
 
     # async generative path -----------------------------------------------
-    def set_async_dispatcher(self, dispatch: Callable[[List[Any]], None]) -> None:
+    def set_async_dispatcher(
+            self, dispatch: Callable[..., None]) -> None:
         """Element installs a callback; an async backend calls it once per
-        produced output frame (≙ nnstreamer_filter_dispatch_output_async)."""
+        produced output frame (≙ nnstreamer_filter_dispatch_output_async).
+        The callback signature is ``dispatch(outputs, ctx=None)`` — the
+        backend hands back the opaque ``ctx`` it was given at
+        ``invoke_async`` time so the element can attribute each output
+        frame to its originating input (the reference passes the
+        GstTensorFilter handle + per-invoke data the same way); with
+        several invokes in flight, omitting ctx mis-stamps frames."""
         self._dispatch = dispatch
 
-    def invoke_async(self, inputs: Sequence[Any]) -> None:
-        """1-in/N-out invoke; outputs flow through the dispatcher."""
+    def invoke_async(self, inputs: Sequence[Any], ctx: Any = None) -> None:
+        """1-in/N-out invoke; outputs flow through the dispatcher, each
+        carrying ``ctx`` back to the element."""
         raise NotImplementedError

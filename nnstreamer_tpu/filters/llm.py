@@ -8,7 +8,14 @@ static shapes, one jitted decode step reused every token.
 
 model accepts ``zoo://gpt?...`` (zoo spec) or a ``get_lm()`` python file
 returning (params, cfg). custom properties (``custom=key:value,...``):
-max_tokens, temperature (0 = greedy), seed, max_len.
+max_tokens, temperature (0 = greedy), seed, max_len, n_parallel.
+
+``n_parallel:M`` (M>1) turns on continuous-batching decode: up to M
+concurrent prompts share ONE decode dispatch per token step (the
+TPU-first answer to llamacpp's n_batch, tensor_filter_llamacpp.cc:267)
+— prompts are prefetched into per-slot cache lanes as slots free up, so
+decode dispatch count scales with max(stream depth), not
+streams x tokens.
 """
 from __future__ import annotations
 
@@ -24,6 +31,12 @@ from .base import (FilterFramework, FilterProperties,
                    parse_custom_properties as _parse_custom)
 from .registry import register_alias, register_filter
 
+# default shared-cache length in n_parallel mode. The batched cache is
+# allocated ONCE (static shapes), so unlike the single-stream path the
+# default cannot derive from each prompt's bucket; longer prompts need an
+# explicit custom=max_len:N.
+DEFAULT_BATCH_MAX_LEN = 128
+
 
 @register_filter
 class LlmFilter(FilterFramework):
@@ -37,6 +50,10 @@ class LlmFilter(FilterFramework):
         self._opts: Dict[str, str] = {}
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
+        # continuous-batching scheduler state (n_parallel > 1)
+        self._pending: List[tuple] = []
+        self._cond = threading.Condition()
+        self._sched: Optional[threading.Thread] = None
 
     def open(self, props: FilterProperties) -> None:
         import jax
@@ -77,17 +94,31 @@ class LlmFilter(FilterFramework):
 
         self._decode = jax.jit(step)
         self._prefill = jax.jit(pre)
+        self._decode_multi = jax.jit(
+            lambda p, c, t, a: tfm.decode_step_multi(p, c, t, a, cfg))
+        self._insert = jax.jit(tfm.cache_insert)
         self._tfm = tfm
+        self._n_parallel = int(self._opts.get("n_parallel", "1"))
+        with self._cond:
+            # prompts queued before a close() belong to the previous
+            # session (and carry its ctx buffers) — never replay them
+            self._pending.clear()
         self._stop.clear()
         # dispatch accounting: prompts of any length must cost ONE
-        # prefill dispatch (≙ llamacpp n_batch), then one per token
+        # prefill dispatch (≙ llamacpp n_batch), then one per token STEP
+        # (shared across n_parallel streams)
         self.stats = {"prefill_dispatches": 0, "decode_dispatches": 0}
 
     def close(self) -> None:
         self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
         for t in self._threads:
             t.join(timeout=5.0)
         self._threads.clear()
+        if self._sched is not None:
+            self._sched.join(timeout=5.0)
+            self._sched = None
         self._params = None
         self._decode = None
 
@@ -99,39 +130,53 @@ class LlmFilter(FilterFramework):
         return TensorsInfo.make("int32", "1")
 
     # -- generation -------------------------------------------------------
+    def _check_prompt(self, prompt: np.ndarray, max_len: int) -> None:
+        """Fail before dispatch: the jitted cache write would raise an
+        opaque XLA shape error (≙ llamacpp context-overflow error)."""
+        if prompt.size == 0:
+            raise ValueError("llm: empty prompt")
+        if prompt.size > max_len:
+            raise ValueError(
+                f"llm: prompt length {prompt.size} exceeds max_len "
+                f"{max_len}; raise custom=max_len:N")
+
+    def _prefill_prompt(self, prompt: np.ndarray, max_len: int):
+        """Bucket-pad the prompt and run ONE prefill dispatch into a
+        fresh batch-1 cache of ``max_len``; returns (logits, cache).
+        Prompts pad to power-of-two buckets so streams of varied lengths
+        compile O(log max_len) prefill shapes, not one per length."""
+        import jax.numpy as jnp
+
+        bucket = 8
+        while bucket < prompt.size:
+            bucket *= 2
+        bucket = min(bucket, max_len)
+        padded = np.zeros(bucket, np.int32)
+        padded[:prompt.size] = prompt
+        cache = self._tfm.init_cache(self._cfg, batch=1, max_len=max_len)
+        logits, cache = self._prefill(
+            self._params, cache, jnp.asarray(padded[None, :]),
+            jnp.asarray(prompt.size, jnp.int32))
+        self.stats["prefill_dispatches"] += 1
+        return logits, cache
+
     def _generate(self, prompt: np.ndarray, emit) -> None:
         import jax
         import jax.numpy as jnp
 
         prompt = np.asarray(prompt).reshape(-1)
-        if prompt.size == 0:
-            raise ValueError("llm: empty prompt")
         max_tokens = int(self._opts.get("max_tokens", "16"))
         temperature = float(self._opts.get("temperature", "0"))
-        # prompts pad to power-of-two buckets so streams of varied
-        # lengths compile O(log max_len) prefill shapes, not one per
-        # length; the DEFAULT max_len is derived from the bucket (not
-        # the raw prompt length) so the cache shape — and with it the
+        # the DEFAULT max_len derives from the bucket (not the raw
+        # prompt length) so the cache shape — and with it the
         # decode-step compilation — is bucket-stable too
         bucket = 8
-        while bucket < prompt.size:
+        while bucket < max(prompt.size, 1):
             bucket *= 2
         max_len = int(self._opts.get("max_len", str(bucket + max_tokens)))
         key = jax.random.PRNGKey(int(self._opts.get("seed", "0")))
-        if prompt.size > max_len:
-            # fail before dispatch: the jitted cache write would raise an
-            # opaque XLA shape error (≙ llamacpp context-overflow error)
-            raise ValueError(
-                f"llm: prompt length {prompt.size} exceeds max_len "
-                f"{max_len}; raise custom=max_len:N")
-        cache = self._tfm.init_cache(self._cfg, batch=1, max_len=max_len)
-        bucket = min(bucket, max_len)
-        padded = np.zeros(bucket, np.int32)
-        padded[:prompt.size] = prompt
-        logits, cache = self._prefill(
-            self._params, cache, jnp.asarray(padded[None, :]),
-            jnp.asarray(prompt.size, jnp.int32))
-        self.stats["prefill_dispatches"] += 1
+        self._check_prompt(prompt, max_len)
+        logits, cache = self._prefill_prompt(prompt, max_len)
         pos = prompt.size  # host-side cache index: no per-token device sync
         for i in range(max_tokens):
             if self._stop.is_set():
@@ -156,19 +201,127 @@ class LlmFilter(FilterFramework):
         return [np.concatenate(tokens) if tokens
                 else np.zeros((0,), np.int32)]
 
-    def invoke_async(self, inputs: Sequence[Any]) -> None:
-        """1-in/N-out: one output frame per generated token."""
+    def invoke_async(self, inputs: Sequence[Any], ctx: Any = None) -> None:
+        """1-in/N-out: one output frame per generated token, each
+        dispatched with this invoke's ``ctx``."""
         prompt = np.asarray(inputs[0])
+        if self._n_parallel > 1:
+            # validate on the CALLER's thread so an oversized prompt is a
+            # visible invoke error, not a silent scheduler drop
+            flat = prompt.reshape(-1)
+            self._check_prompt(flat, int(self._opts.get(
+                "max_len", str(DEFAULT_BATCH_MAX_LEN))))
+            with self._cond:
+                self._pending.append((flat, ctx))
+                self._cond.notify_all()
+                # start-check under the lock: two racing invokes must not
+                # spawn two schedulers splitting one slot pool
+                if self._sched is None or not self._sched.is_alive():
+                    self._sched = threading.Thread(
+                        target=self._sched_loop, name="llm-sched",
+                        daemon=True)
+                    self._sched.start()
+            return
 
         def run():
             try:
-                self._generate(prompt, lambda tok: self._dispatch([tok]))
+                self._generate(
+                    prompt, lambda tok: self._dispatch([tok], ctx))
             except Exception:  # noqa: BLE001
                 logger.exception("llm generation failed")
 
         t = threading.Thread(target=run, name="llm-generate", daemon=True)
         self._threads.append(t)
         t.start()
+
+    # -- continuous-batching scheduler (n_parallel > 1) --------------------
+    def _sched_loop(self) -> None:
+        """Decode M streams per dispatch. Admission: pending prompts are
+        prefilled (one bucketed dispatch each) into free cache slots;
+        every active slot then advances one token per SHARED decode
+        dispatch, and finished slots free up mid-flight for waiting
+        prompts — continuous batching, not static batching."""
+        try:
+            self._sched_body()
+        except Exception:  # noqa: BLE001 — daemon thread: log, don't die silent
+            logger.exception("llm scheduler failed; in-flight streams lost")
+
+    def _sched_body(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        tfm, cfg = self._tfm, self._cfg
+        m = self._n_parallel
+        max_tokens = int(self._opts.get("max_tokens", "16"))
+        max_len = int(self._opts.get("max_len", str(DEFAULT_BATCH_MAX_LEN)))
+        temperature = float(self._opts.get("temperature", "0"))
+        seed = int(self._opts.get("seed", "0"))
+        cache = tfm.init_cache_multi(cfg, batch=m, max_len=max_len)
+        logits = jnp.zeros((m, cfg.vocab), jnp.float32)
+        tok = jnp.zeros((m,), jnp.int32)
+        streams: List[Optional[Dict[str, Any]]] = [None] * m
+        while not self._stop.is_set():
+            # -- admit pending prompts into free slots
+            with self._cond:
+                while all(s is None for s in streams) and not self._pending \
+                        and not self._stop.is_set():
+                    self._cond.wait(0.1)
+                if self._stop.is_set():
+                    return
+                admit = []
+                for slot in range(m):
+                    if streams[slot] is None and self._pending:
+                        admit.append((slot, *self._pending.pop(0)))
+            for slot, prompt, ctx in admit:
+                try:
+                    self._check_prompt(prompt, max_len)
+                    l1, c1 = self._prefill_prompt(prompt, max_len)
+                except Exception:  # noqa: BLE001 — drop THIS prompt only
+                    logger.exception("llm: prompt rejected at admission")
+                    continue
+                cache = self._insert(cache, c1, jnp.asarray(slot, jnp.int32))
+                logits = logits.at[slot].set(l1[0])
+                # per-stream PRNG key: the sample sequence matches the
+                # n_parallel=1 path for the same seed, independent of
+                # which other prompts happen to be in flight
+                streams[slot] = {"ctx": ctx, "remaining": max_tokens,
+                                 "pos": int(prompt.size),
+                                 "key": jax.random.PRNGKey(seed)}
+            active_np = np.array([s is not None for s in streams])
+            if not active_np.any():
+                continue
+            # -- sample on device, D2H just the M token ids
+            if temperature > 0:
+                subs = []
+                for s in streams:
+                    if s is None:
+                        subs.append(jax.random.PRNGKey(0))
+                        continue
+                    s["key"], sub = jax.random.split(s["key"])
+                    subs.append(sub)
+                tok = jax.vmap(
+                    lambda k, l: jax.random.categorical(k, l / temperature))(
+                        jnp.stack(subs), logits)
+            else:
+                tok = jnp.argmax(logits, -1)
+            tok = tok.astype(jnp.int32)
+            tok_host = np.asarray(tok)
+            for slot, s in enumerate(streams):
+                if s is None:
+                    continue
+                self._dispatch([tok_host[slot:slot + 1]], s["ctx"])
+                s["remaining"] -= 1
+                s["pos"] += 1
+                # pos is one past the next decode's cache-write position
+                # (the write lands at pos-1), so the stream survives
+                # while pos <= max_len — matching the single-stream
+                # loop's emit-then-check ordering exactly
+                if s["remaining"] <= 0 or s["pos"] > max_len:
+                    streams[slot] = None
+            if any(s is not None for s in streams):
+                logits, cache = self._decode_multi(
+                    self._params, cache, tok, jnp.asarray(active_np))
+                self.stats["decode_dispatches"] += 1
 
 
 register_alias("llamacpp", "llm")

@@ -231,27 +231,71 @@ def decode_step(params, cache, token, cfg: GPTConfig):
 
     The cache is functional state threaded by the caller — the XLA-friendly
     shape of llamacpp's internal context (static shapes, dynamic_update_slice).
-    """
+    A thin shim over :func:`decode_step_multi` (shared scalar index
+    broadcast to per-row positions) so the single- and multi-stream paths
+    cannot drift."""
     b = token.shape[0]
-    pos = cache["index"]
-    positions = jnp.full((b, 1), pos, jnp.int32)
+    mcache = {"k": cache["k"], "v": cache["v"],
+              "index": jnp.broadcast_to(cache["index"], (b,))}
+    logits, mcache = decode_step_multi(
+        params, mcache, token, jnp.ones((b,), bool), cfg)
+    return logits, {"k": mcache["k"], "v": mcache["v"],
+                    "index": mcache["index"][0]}
+
+
+def init_cache_multi(cfg: GPTConfig, batch: int, max_len: int) -> Dict[str, Any]:
+    """Continuous-batching cache: per-slot positions (index [B]) so B
+    independent streams at different depths share one decode dispatch."""
+    cache = init_cache(cfg, batch, max_len)
+    cache["index"] = jnp.zeros((batch,), jnp.int32)
+    return cache
+
+
+def cache_insert(bcache, cache1, slot):
+    """Insert a batch-1 prefill cache into slot ``slot`` of a
+    multi-stream cache (same max_len). The whole K/V slice is replaced,
+    so stale tokens from the slot's previous occupant cannot leak."""
+    k = jax.lax.dynamic_update_slice(
+        bcache["k"], cache1["k"].astype(bcache["k"].dtype), (0, slot, 0, 0, 0))
+    v = jax.lax.dynamic_update_slice(
+        bcache["v"], cache1["v"].astype(bcache["v"].dtype), (0, slot, 0, 0, 0))
+    idx = jax.lax.dynamic_update_slice(
+        bcache["index"], cache1["index"].reshape(1).astype(jnp.int32), (slot,))
+    return {"k": k, "v": v, "index": idx}
+
+
+def decode_step_multi(params, cache, token, active, cfg: GPTConfig):
+    """One decode step for B *independent* streams in ONE dispatch
+    (continuous-batching lite — the TPU-first answer to llamacpp's
+    n_batch, tensor_filter_llamacpp.cc:267). token [B] int32,
+    active [B] bool; cache index is per-slot [B]. Inactive slots do not
+    advance their index; their lanes compute garbage that the scheduler
+    never emits."""
+    b = token.shape[0]
+    pos = cache["index"]                       # [B]
+    positions = pos[:, None]                   # [B,1]
     h = jnp.take(params["embed"], token[:, None], axis=0)
-    new_k, new_v = [], []
     max_len = cache["k"].shape[2]
-    valid = jnp.arange(max_len) <= pos  # [L]
+    valid = jnp.arange(max_len)[None, :] <= pos[:, None]   # [B,L]
+    # per-slot cache write: each row lands at its own position
+    upd = jax.vmap(
+        lambda c, x, p: jax.lax.dynamic_update_slice(c, x, (p, 0, 0)))
+    new_k, new_v = [], []
     for i, layer in enumerate(params["layers"]):
         hd, nh = cfg.head_dim, cfg.n_heads
         x = rmsnorm(h, layer["ln1"])
-        q = rope((x @ layer["wq"]).reshape(b, 1, nh, hd), positions, cfg.rope_theta)
-        k1 = rope((x @ layer["wk"]).reshape(b, 1, nh, hd), positions, cfg.rope_theta)
+        q = rope((x @ layer["wq"]).reshape(b, 1, nh, hd), positions,
+                 cfg.rope_theta)
+        k1 = rope((x @ layer["wk"]).reshape(b, 1, nh, hd), positions,
+                  cfg.rope_theta)
         v1 = (x @ layer["wv"]).reshape(b, 1, nh, hd)
-        k = jax.lax.dynamic_update_slice(cache["k"][i], k1, (0, pos, 0, 0))
-        v = jax.lax.dynamic_update_slice(cache["v"][i], v1, (0, pos, 0, 0))
+        k = upd(cache["k"][i], k1.astype(cache["k"].dtype), pos)
+        v = upd(cache["v"][i], v1.astype(cache["v"].dtype), pos)
         new_k.append(k)
         new_v.append(v)
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
         scores = scores * (hd ** -0.5)
-        scores = jnp.where(valid[None, None, None, :], scores, -1e30)
+        scores = jnp.where(valid[:, None, None, :], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
         attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
         h = h + attn.reshape(b, 1, -1) @ layer["wo"]
@@ -260,7 +304,8 @@ def decode_step(params, cache, token, cfg: GPTConfig):
         h = h + ff @ layer["w2"]
     h = rmsnorm(h, params["ln_f"])
     logits = (h[:, 0] @ params["head"]).astype(jnp.float32)
-    cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v), "index": pos + 1}
+    cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v),
+             "index": pos + active.astype(jnp.int32)}
     return logits, cache
 
 

@@ -8,7 +8,7 @@ import queue as _pyqueue
 import threading
 from typing import Callable, List, Optional
 
-from ..tensors.buffer import Buffer
+from ..tensors.buffer import Buffer, Chunk
 from ..tensors.caps import Caps
 from ..utils.log import logger
 from .element import Element, SinkElement, SrcElement, TransformElement
@@ -308,13 +308,19 @@ class TensorTestSrc(SrcElement):
     reference test pipelines). Generates frames matching its ``caps``
     property with a chosen fill pattern; PTS synthesized from framerate."""
 
-    PROPS = {"caps": "", "pattern": "counter", "seed": 0, "is-live": False}
+    # device=true pre-stages a pool of frames in HBM and cycles them, so
+    # the stream is device-resident from the source on (MLPerf-offline
+    # style): downstream device elements see zero H2D cost, isolating
+    # the runtime's own per-buffer overhead from the host link
+    PROPS = {"caps": "", "pattern": "counter", "seed": 0, "is-live": False,
+             "device": False, "pool-size": 4}
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self._config = None
         self._count = 0
         self._rng = None
+        self._pool = None
 
     def negotiate_src_caps(self) -> Optional[Caps]:
         if not self.caps:
@@ -325,13 +331,10 @@ class TensorTestSrc(SrcElement):
         self._config = caps.to_config()
         return caps
 
-    def create(self) -> Optional[Buffer]:
+    def _make_frame(self, count: int):
         import numpy as np
-        if self._rng is None:
-            self._rng = np.random.default_rng(self.seed)
-        cfg = self._config
-        chunks = []
-        for info in cfg.info:
+        arrays = []
+        for info in self._config.info:
             dt = info.type.np_dtype
             if self.pattern == "zeros":
                 arr = np.zeros(info.shape, dtype=dt)
@@ -345,8 +348,25 @@ class TensorTestSrc(SrcElement):
                 else:
                     arr = self._rng.random(info.shape).astype(dt)
             else:  # counter
-                arr = np.full(info.shape, self._count).astype(dt)
-            chunks.append(Buffer.from_arrays([arr])[0])
+                arr = np.full(info.shape, count).astype(dt)
+            arrays.append(arr)
+        return arrays
+
+    def create(self) -> Optional[Buffer]:
+        import numpy as np
+        if self._rng is None:
+            self._rng = np.random.default_rng(self.seed)
+        if self.device:
+            if self._pool is None:
+                import jax
+                n = max(1, int(self.pool_size))
+                self._pool = [
+                    [Chunk(jax.device_put(a)) for a in self._make_frame(i)]
+                    for i in range(n)]
+            chunks = self._pool[self._count % len(self._pool)]
+        else:
+            chunks = [Chunk(a) for a in self._make_frame(self._count)]
+        cfg = self._config
         dur = cfg.frame_duration_ns()
         pts = self._count * dur if dur else self._count
         self._count += 1

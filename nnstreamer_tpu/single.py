@@ -58,12 +58,13 @@ class SingleShot:
 
     def set_async_callback(self, cb: Callable[[List[Any]], None]) -> None:
         self._async_cb = cb
-        self.fw.set_async_dispatcher(cb)
+        # user callbacks take just the outputs; drop the per-invoke ctx
+        self.fw.set_async_dispatcher(lambda outputs, ctx=None: cb(outputs))
 
-    def invoke_async(self, inputs: Sequence[Any]) -> None:
+    def invoke_async(self, inputs: Sequence[Any], ctx: Any = None) -> None:
         if not self._opened:
             self.start()
-        self.fw.invoke_async(list(inputs))
+        self.fw.invoke_async(list(inputs), ctx=ctx)
 
     def get_model_info(self):
         if not self._opened:

@@ -65,6 +65,35 @@ def capabilities() -> Dict[str, Any]:
     }
 
 
+# peak dense bf16 TFLOPS per JAX DEVICE by device-kind substring,
+# checked in order (first match wins — "v5 lite" must match before
+# "v5"). Public per-chip figures: v2 45, v3 123, v4 275, v5e 197,
+# v5p 459, v6e 918 — but on v2/v3 jax.devices() enumerates TensorCores
+# (2 per chip) and a single-device jit runs on ONE core, so those
+# entries carry the per-core half to keep MFU honest.
+_PEAK_BF16_TFLOPS = (
+    ("v6 lite", 918.0), ("v6e", 918.0),
+    ("v5 lite", 197.0), ("v5litepod", 197.0), ("v5e", 197.0),
+    ("v5p", 459.0), ("v5", 459.0),
+    ("v4", 275.0), ("v3", 61.5), ("v2", 22.5),
+)
+
+
+def peak_flops(device=None):
+    """Peak dense bf16 FLOPS/s for ``device`` (default: first jax
+    device), or None when the kind is unknown (e.g. CPU) — callers must
+    not fabricate an MFU from a guess."""
+    import jax
+    d = device if device is not None else jax.devices()[0]
+    if d.platform != "tpu":
+        return None
+    kind = getattr(d, "device_kind", "").lower()
+    for sub, tflops in _PEAK_BF16_TFLOPS:
+        if sub in kind:
+            return tflops * 1e12
+    return None
+
+
 def is_available(kind: str) -> bool:
     """CHECK_HW_AVAILABILITY answer: is an accelerator of this kind
     (``tpu``/``gpu``/``cpu``/``default``) usable?"""

@@ -116,8 +116,11 @@ class TestElementRestriction:
         monkeypatch.setenv("NNS_TPU_CONF", str(ini))
         conf.reload()
         make_element("fakesink")
+        # core plumbing (tensortestsrc, queue, ...) is exempt like gst
+        # core elements in the reference; nnstreamer elements are not
+        make_element("tensortestsrc")
         with pytest.raises(ValueError, match="restricted"):
-            make_element("tensortestsrc")
+            make_element("tensor_decoder")
 
     def test_no_restriction_by_default(self):
         conf.reload()

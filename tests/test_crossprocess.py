@@ -1,0 +1,220 @@
+"""Cross-process distributed tests — servers/brokers run as REAL
+separate processes driven through the CLI, the reference's SSAT pattern
+(ref: tests/nnstreamer_edge/edge/runTest.sh:105-131 launches gst-launch
+server pipelines and kills them mid-stream). In-process threads prove
+logic; these prove process isolation: no shared SERVER_TABLE, no shared
+GIL, real sockets, real process death."""
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import Buffer, parse_launch
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CAPS = ('other/tensors,format=static,num_tensors=1,'
+        'types=(string)float32,dimensions=(string)4')
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn(cli_args):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    return subprocess.Popen(
+        [sys.executable, "-m", "nnstreamer_tpu", *cli_args],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+
+
+def _wait_port(port, proc, timeout=60):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"server process died: {proc.stdout.read()[:2000]}")
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=1):
+                return
+        except OSError:
+            time.sleep(0.1)
+    raise TimeoutError(f"port {port} never came up")
+
+
+def _stop(proc):
+    if proc.poll() is None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
+def test_query_round_trip_server_in_subprocess():
+    port = _free_port()
+    server = _spawn([
+        f'tensor_query_serversrc port={port} id=0 '
+        '! tensor_transform mode=arithmetic option=mul:2.0 '
+        '! tensor_query_serversink id=0', "--timeout", "120"])
+    try:
+        _wait_port(port, server)
+        client = parse_launch(
+            f'appsrc name=in caps="{CAPS}" '
+            f'! tensor_query_client port={port} timeout=30 '
+            '! appsink name=out')
+        client.start()
+        for i in range(4):
+            client["in"].push_buffer(Buffer.from_arrays(
+                [np.full(4, float(i), np.float32)]))
+        deadline = time.monotonic() + 30
+        while len(client["out"].buffers) < 4 and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+        client["in"].end_stream()
+        client.stop()
+        out = client["out"].buffers
+        assert len(out) == 4
+        for i, b in enumerate(out):
+            np.testing.assert_array_equal(
+                b.chunks[0].host(), np.full(4, 2.0 * i, np.float32))
+    finally:
+        _stop(server)
+
+
+def test_query_failover_across_processes():
+    """Server A dies (real SIGTERM, like the SSAT kill) mid-stream; the
+    client re-discovers via the broker PROCESS and fails over to B."""
+    bport = _free_port()
+    broker = _spawn(["--broker", "discovery", "--port", str(bport),
+                     "--timeout", "180"])
+    server_a = server_b = None
+    try:
+        _wait_port(bport, broker)
+        aport = _free_port()
+        server_a = _spawn([
+            f'tensor_query_serversrc port={aport} id=0 connect-type=HYBRID '
+            f'topic=svc dest-port={bport} '
+            '! tensor_transform mode=arithmetic option=mul:2.0 '
+            '! tensor_query_serversink id=0', "--timeout", "120"])
+        _wait_port(aport, server_a)
+        client = parse_launch(
+            f'appsrc name=in caps="{CAPS}" '
+            f'! tensor_query_client connect-type=HYBRID topic=svc '
+            f'dest-port={bport} timeout=30 '
+            '! appsink name=out')
+        client.start()
+        for i in range(2):
+            client["in"].push_buffer(Buffer.from_arrays(
+                [np.full(4, float(i), np.float32)]))
+        deadline = time.monotonic() + 30
+        while len(client["out"].buffers) < 2 and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert len(client["out"].buffers) == 2  # served by A (x2)
+        # bring up B (x4), kill A, keep streaming
+        byport = _free_port()
+        server_b = _spawn([
+            f'tensor_query_serversrc port={byport} id=0 '
+            f'connect-type=HYBRID topic=svc dest-port={bport} '
+            '! tensor_transform mode=arithmetic option=mul:4.0 '
+            '! tensor_query_serversink id=0', "--timeout", "120"])
+        _wait_port(byport, server_b)
+        _stop(server_a)
+        for i in (10.0, 11.0):
+            client["in"].push_buffer(Buffer.from_arrays(
+                [np.full(4, i, np.float32)]))
+        deadline = time.monotonic() + 40
+        while len(client["out"].buffers) < 4 and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+        client["in"].end_stream()
+        client.stop()
+        out = client["out"].buffers
+        assert len(out) >= 4, f"only {len(out)} results"
+        # the post-failover frames were served by B: x4
+        np.testing.assert_array_equal(out[-2].chunks[0].host(),
+                                      np.full(4, 40.0, np.float32))
+        np.testing.assert_array_equal(out[-1].chunks[0].host(),
+                                      np.full(4, 44.0, np.float32))
+    finally:
+        _stop(broker)
+        for p in (server_a, server_b):
+            if p is not None:
+                _stop(p)
+
+
+def test_edge_fanout_publisher_in_subprocess():
+    """A live publisher pipeline in its own process; two subscriber
+    pipelines in this one, both fed by topic fan-out."""
+    port = _free_port()
+    pub = _spawn([
+        f'tensortestsrc caps="{CAPS},framerate=10/1" pattern=counter '
+        'is-live=true num-buffers=40 '
+        f'! edgesink port={port} topic=cam', "--timeout", "120"])
+    subs = []
+    try:
+        _wait_port(port, pub)
+        for _ in range(2):
+            s = parse_launch(
+                f'edgesrc dest-port={port} topic=cam timeout=15 '
+                '! appsink name=out')
+            s.start()
+            subs.append(s)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not all(
+                len(s["out"].buffers) >= 3 for s in subs):
+            time.sleep(0.05)
+        for s in subs:
+            s.stop()
+        for s in subs:
+            got = s["out"].buffers
+            assert len(got) >= 3, f"subscriber saw {len(got)} frames"
+            # counter pattern: monotonically increasing frame values
+            vals = [float(b.chunks[0].host()[0]) for b in got]
+            assert vals == sorted(vals)
+    finally:
+        _stop(pub)
+
+
+def test_mqtt_broker_in_subprocess():
+    """mqttsink/mqttsrc interop through a broker PROCESS speaking real
+    MQTT 3.1.1 (the mosquitto stand-in)."""
+    port = _free_port()
+    broker = _spawn(["--broker", "mqtt", "--port", str(port),
+                     "--timeout", "120"])
+    try:
+        _wait_port(port, broker)
+        sub = parse_launch(
+            f'mqttsrc port={port} sub-topic=nns/t timeout=15 '
+            '! appsink name=out')
+        sub.start()
+        time.sleep(0.2)
+        pub = parse_launch(
+            f'appsrc name=in caps="{CAPS}" '
+            f'! mqttsink pub-topic=nns/t port={port}')
+        pub.start()
+        pub["in"].push_buffer(Buffer.from_arrays(
+            [np.full(4, 7.0, np.float32)]))
+        deadline = time.monotonic() + 15
+        while not sub["out"].buffers and time.monotonic() < deadline:
+            time.sleep(0.05)
+        pub["in"].end_stream()
+        pub.stop()
+        sub.stop()
+        assert len(sub["out"].buffers) == 1
+        np.testing.assert_array_equal(
+            sub["out"].buffers[0].chunks[0].host(),
+            np.full(4, 7.0, np.float32))
+    finally:
+        _stop(broker)

@@ -95,6 +95,51 @@ def test_query_multiple_clients():
                                       np.full(4, 100.0 + i, np.float32))
 
 
+def test_query_server_microbatch_round_trip():
+    """serversrc batch=4: frames from concurrent clients are stacked into
+    shared invokes and every result still routes to ITS client with ITS
+    pts (padded rows are dropped, order per client preserved)."""
+    port = _free_port()
+    server = parse_launch(
+        f'tensor_query_serversrc port={port} id=4 batch=4 '
+        '! tensor_transform mode=arithmetic option=mul:3.0 '
+        '! tensor_query_serversink id=4')
+    server.start()
+    time.sleep(0.2)
+    results = {}
+
+    def run_client(tag):
+        c = parse_launch(
+            f'appsrc name=in caps="{CAPS}" '
+            f'! tensor_query_client port={port} timeout=15 max-request=8 '
+            '! appsink name=out')
+        c.start()
+        for j in range(3):
+            c["in"].push_buffer(Buffer.from_arrays(
+                [np.full(4, 10.0 * tag + j, np.float32)], pts=j * 100))
+        deadline = time.monotonic() + 20
+        while len(c["out"].buffers) < 3 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        results[tag] = [(b.pts, b.chunks[0].host().copy())
+                        for b in c["out"].buffers]
+        c["in"].end_stream()
+        c.stop()
+
+    threads = [threading.Thread(target=run_client, args=(i,))
+               for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    server.stop()
+    for tag in range(3):
+        assert len(results[tag]) == 3, results[tag]
+        for j, (pts, arr) in enumerate(results[tag]):
+            assert pts == j * 100  # row kept its own frame's pts
+            np.testing.assert_array_equal(
+                arr, np.full(4, 3.0 * (10.0 * tag + j), np.float32))
+
+
 def test_edge_pub_sub_fanout():
     port = _free_port()
     pub = parse_launch(

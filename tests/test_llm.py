@@ -59,6 +59,179 @@ def test_llm_async_token_stream_pipeline():
         assert b.chunks[0].shape == (1,)
 
 
+def test_async_two_inflight_prompts_keep_their_pts():
+    """Two prompts in flight: every token buffer must carry ITS prompt's
+    PTS (regression for the single-template race at the element level)
+    and the right tokens, with n_parallel decode sharing dispatches."""
+    pipe = parse_launch(
+        f'appsrc name=in caps="{CAPS}" '
+        f'! tensor_filter framework=llm model="{ZOO}" invoke-async=true '
+        'custom="max_tokens:4,n_parallel:2,max_len:32" invoke-dynamic=true '
+        '! appsink name=out')
+    pipe.start()
+    p1 = np.array([1, 2, 3, 4], np.int32)
+    p2 = np.array([9, 8, 7, 6], np.int32)
+    pipe["in"].push_buffer(Buffer.from_arrays([p1], pts=1000))
+    pipe["in"].push_buffer(Buffer.from_arrays([p2], pts=2000))
+    deadline = time.monotonic() + 120
+    while len(pipe["out"].buffers) < 8 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    pipe["in"].end_stream()
+    pipe.stop()
+    out = pipe["out"].buffers
+    assert len(out) == 8
+    by_pts = {1000: [], 2000: []}
+    for b in out:
+        assert b.pts in by_pts, f"token frame with foreign pts {b.pts}"
+        by_pts[b.pts].append(int(b.chunks[0].host()[0]))
+    assert len(by_pts[1000]) == 4 and len(by_pts[2000]) == 4
+    # tokens must match the single-stream greedy reference per prompt
+    from nnstreamer_tpu.filters.base import FilterProperties
+    from nnstreamer_tpu.filters.registry import find_filter
+    fw = find_filter("llm")()
+    fw.open(FilterProperties(model_files=(ZOO,),
+                             custom_properties="max_tokens:4,max_len:32"))
+    np.testing.assert_array_equal(by_pts[1000], fw.invoke([p1])[0])
+    np.testing.assert_array_equal(by_pts[2000], fw.invoke([p2])[0])
+    fw.close()
+
+
+def test_batched_decode_shares_dispatches():
+    """n_parallel=2: two concurrent streams decode in shared dispatches
+    — decode_dispatches ≈ max_tokens, NOT streams x tokens — and each
+    stream's tokens match its single-stream greedy reference."""
+    from nnstreamer_tpu.filters.base import FilterProperties
+    from nnstreamer_tpu.filters.registry import find_filter
+    fw = find_filter("llm")()
+    fw.open(FilterProperties(
+        model_files=(ZOO,), invoke_async=True,
+        custom_properties="max_tokens:6,n_parallel:2,max_len:32"))
+    got = {}
+    done = {}
+    def dispatch(outputs, ctx=None):
+        got.setdefault(ctx, []).append(int(outputs[0][0]))
+        if len(got[ctx]) == 6:
+            done[ctx] = True
+    fw.set_async_dispatcher(dispatch)
+    p1 = np.array([1, 2, 3], np.int32)
+    p2 = np.array([40, 41, 42, 43, 44], np.int32)
+    fw.invoke_async([p1], ctx="a")
+    fw.invoke_async([p2], ctx="b")
+    deadline = time.monotonic() + 120
+    while len(done) < 2 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    n_decode = fw.stats["decode_dispatches"]
+    assert len(done) == 2
+    fw.close()
+    # 2 streams x 6 tokens = 12 per-stream dispatches; shared batched
+    # decode needs at most ~6 (+1 slack for admission skew)
+    assert n_decode <= 7, n_decode
+    ref = find_filter("llm")()
+    ref.open(FilterProperties(model_files=(ZOO,),
+                              custom_properties="max_tokens:6,max_len:32"))
+    np.testing.assert_array_equal(got["a"], ref.invoke([p1])[0])
+    np.testing.assert_array_equal(got["b"], ref.invoke([p2])[0])
+    ref.close()
+
+
+def test_batched_max_len_boundary_matches_single():
+    """A stream that hits max_len must emit the SAME number of tokens in
+    batched mode as in single-stream mode (emit-then-check ordering)."""
+    from nnstreamer_tpu.filters.base import FilterProperties
+    from nnstreamer_tpu.filters.registry import find_filter
+    prompt = np.arange(1, 16, dtype=np.int32)  # 15 tokens, max_len 16
+    ref = find_filter("llm")()
+    ref.open(FilterProperties(model_files=(ZOO,),
+                              custom_properties="max_tokens:8,max_len:16"))
+    want = ref.invoke([prompt])[0]
+    ref.close()
+    fw = find_filter("llm")()
+    fw.open(FilterProperties(
+        model_files=(ZOO,), invoke_async=True,
+        custom_properties="max_tokens:8,max_len:16,n_parallel:2"))
+    got = []
+    fw.set_async_dispatcher(lambda o, ctx=None: got.append(int(o[0][0])))
+    fw.invoke_async([prompt], ctx=None)
+    deadline = time.monotonic() + 120
+    while len(got) < len(want) and time.monotonic() < deadline:
+        time.sleep(0.05)
+    time.sleep(0.2)  # would catch any EXTRA token beyond the reference
+    fw.close()
+    np.testing.assert_array_equal(got, want)
+
+
+def test_batched_sampling_reproducible_per_stream():
+    """temperature>0 with n_parallel: each stream owns its PRNG key, so
+    sampled tokens match the n_parallel=1 path for the same seed,
+    regardless of co-resident streams."""
+    from nnstreamer_tpu.filters.base import FilterProperties
+    from nnstreamer_tpu.filters.registry import find_filter
+    opts = "max_tokens:5,temperature:0.8,seed:3,max_len:32"
+    ref = find_filter("llm")()
+    ref.open(FilterProperties(model_files=(ZOO,), custom_properties=opts))
+    p1 = np.array([1, 2, 3], np.int32)
+    p2 = np.array([7, 8], np.int32)
+    want1, want2 = ref.invoke([p1])[0], ref.invoke([p2])[0]
+    ref.close()
+    fw = find_filter("llm")()
+    fw.open(FilterProperties(model_files=(ZOO,), invoke_async=True,
+                             custom_properties=opts + ",n_parallel:2"))
+    got, done = {}, set()
+    def dispatch(outputs, ctx=None):
+        got.setdefault(ctx, []).append(int(outputs[0][0]))
+        if len(got[ctx]) == 5:
+            done.add(ctx)
+    fw.set_async_dispatcher(dispatch)
+    fw.invoke_async([p1], ctx="a")
+    fw.invoke_async([p2], ctx="b")
+    deadline = time.monotonic() + 120
+    while len(done) < 2 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    fw.close()
+    np.testing.assert_array_equal(got["a"], want1)
+    np.testing.assert_array_equal(got["b"], want2)
+
+
+def test_decode_step_multi_matches_single():
+    """decode_step_multi with per-slot positions reproduces two
+    independent decode_step loops exactly (same cache layout, same
+    logits), including slots at different depths."""
+    import jax
+    import jax.numpy as jnp
+    from nnstreamer_tpu.models import transformer as tfm
+
+    cfg = tfm.GPTConfig(vocab=64, d_model=32, n_heads=4, n_layers=2,
+                        d_ff=64, dtype=jnp.float32)
+    params = tfm.init_params(cfg, jax.random.PRNGKey(1))
+    prompts = [jnp.array([[3, 11, 25]], jnp.int32),
+               jnp.array([[40, 7, 19, 22, 5]], jnp.int32)]
+    # single-stream references
+    refs = []
+    for p in prompts:
+        logits, cache = tfm.prefill(params, tfm.init_cache(cfg, 1, 16), p, cfg)
+        toks = []
+        for _ in range(4):
+            t = jnp.argmax(logits, -1).astype(jnp.int32)
+            toks.append(int(t[0]))
+            logits, cache = tfm.decode_step(params, cache, t, cfg)
+        refs.append(toks)
+    # multi-stream: insert both prefills into a 2-slot cache, decode together
+    mcache = tfm.init_cache_multi(cfg, 2, 16)
+    logits = jnp.zeros((2, cfg.vocab), jnp.float32)
+    for slot, p in enumerate(prompts):
+        l1, c1 = tfm.prefill(params, tfm.init_cache(cfg, 1, 16), p, cfg)
+        mcache = tfm.cache_insert(mcache, c1, jnp.asarray(slot, jnp.int32))
+        logits = logits.at[slot].set(l1[0])
+    outs = [[], []]
+    active = jnp.ones((2,), bool)
+    for _ in range(4):
+        tok = jnp.argmax(logits, -1).astype(jnp.int32)
+        for slot in range(2):
+            outs[slot].append(int(tok[slot]))
+        logits, mcache = tfm.decode_step_multi(params, mcache, tok, active, cfg)
+    assert outs == refs
+
+
 def test_llamacpp_alias():
     from nnstreamer_tpu.filters.registry import find_filter
     assert find_filter("llamacpp").NAME == "llm"

@@ -142,3 +142,50 @@ def test_query_fanout_to_mesh_server():
         assert len(results[i]) == 1, f"client {i} got {results[i]}"
         np.testing.assert_allclose(results[i][0], want[i],
                                    rtol=1e-4, atol=1e-4)
+
+
+def test_query_microbatch_lands_sharded_on_mesh():
+    """VERDICT r3 item 3: serversrc batch>1 stacks frames from several
+    clients into ONE invoke whose batch dim rides the mesh data axis —
+    batched invoke over ICI, not per-frame dispatch."""
+    port = _free_port()
+    server = parse_launch(
+        f'tensor_query_serversrc name=qs port={port} id=8 batch=4 '
+        '! tensor_filter name=f framework=jax '
+        'model=zoo://mlp?dtype=float32 custom="mesh:4x1x2,rules:gpt" '
+        '! tensor_query_serversink id=8')
+    server.start()
+    time.sleep(0.2)
+
+    ref = _open_filter()
+    n_frames = 6
+    xs = {i: np.random.RandomState(30 + i).randn(8, 64).astype(np.float32)
+          for i in range(n_frames)}
+    want = {i: np.asarray(ref.invoke([xs[i]])[0]) for i in xs}
+    ref.close()
+
+    c = parse_launch(
+        f'appsrc name=in caps="{CAPS8x64}" '
+        f'! tensor_query_client port={port} timeout=20 max-request=8 '
+        '! appsink name=out')
+    c.start()
+    for i in range(n_frames):
+        c["in"].push_buffer(Buffer.from_arrays([xs[i]]))
+    deadline = time.monotonic() + 40
+    while len(c["out"].buffers) < n_frames and time.monotonic() < deadline:
+        time.sleep(0.05)
+    c["in"].end_stream()
+    n_invokes = server["f"]._invoke_count
+    fw = server["f"].fw
+    # stacked signature reached the backend: some executable was compiled
+    # for a leading batch dim of 4 (i.e. input (4, 8, 64))
+    sigs = list(fw._jit_cache)
+    c.stop()
+    server.stop()
+    out = c["out"].buffers
+    assert len(out) == n_frames
+    for i, b in enumerate(out):
+        np.testing.assert_allclose(b.chunks[0].host(), want[i],
+                                   rtol=1e-4, atol=1e-4)
+    assert n_invokes < n_frames, (n_invokes, n_frames)
+    assert any(sig[0][0] == (4, 8, 64) for sig in sigs), sigs

@@ -79,7 +79,11 @@ def test_two_subscribers_and_wildcard():
 
 def test_base_time_retiming():
     """new_pts = (pub_base_epoch + pts) - sub_base_epoch
-    (≙ synchronization-in-mqtt-elements.md timestamp conversion)."""
+    (≙ synchronization-in-mqtt-elements.md timestamp conversion). The
+    publisher here is a RAW MQTT 3.1.1 client (hand-rolled packets +
+    reference GstMQTTMessageHdr payload), proving a foreign standard
+    client's messages parse."""
+    from nnstreamer_tpu.edge import mqtt_wire as mw
     broker = MqttBroker(port=0).start()
     sub = parse_launch(
         f'mqttsrc name=src port={broker.bound_port} sub-topic=t timeout=10 '
@@ -89,19 +93,119 @@ def test_base_time_retiming():
     sub_base = sub["src"]._base_epoch_ns
     # craft a publisher whose base-time is exactly 5 ms after ours
     with socket.create_connection(("localhost", broker.bound_port)) as s:
+        s.sendall(mw.connect_packet("foreign-pub"))
+        ptype, _, body = mw.read_packet(s)
+        assert ptype == mw.CONNACK and body[1] == 0
         arr = np.ones(4, np.float32)
-        send_msg(s, MsgKind.PUBLISH, {
-            "topic": "t", "caps": CAPS,
-            "base_time_epoch_ns": sub_base + 5_000_000,
-            "pts": 100, "duration": None,
-            "tensors": [{"dtype": "float32", "shape": [4]}],
-        }, [arr.tobytes()])
+        hdr = mw.pack_msg_hdr([arr.nbytes], CAPS, sub_base + 5_000_000,
+                              sub_base + 5_000_000, None, None, 100)
+        s.sendall(mw.publish_packet("t", hdr + arr.tobytes()))
         deadline = time.monotonic() + 10
         while not sub["out"].buffers and time.monotonic() < deadline:
             time.sleep(0.05)
     sub.stop()
     broker.stop()
     assert sub["out"].buffers[0].pts == 5_000_100
+
+
+class TestMqttPacketGoldens:
+    """Packet-level golden bytes pinned to the MQTT 3.1.1 spec, so the
+    codec cannot drift into a self-consistent private dialect."""
+
+    def test_connect_packet_bytes(self):
+        from nnstreamer_tpu.edge import mqtt_wire as mw
+        pkt = mw.connect_packet("ab", keepalive=60)
+        assert pkt == bytes.fromhex(
+            "10"        # CONNECT, flags 0
+            "0e"        # remaining length 14
+            "00044d515454"  # "MQTT"
+            "04"        # protocol level 4 (3.1.1)
+            "02"        # connect flags: clean session
+            "003c"      # keepalive 60
+            "00026162")  # client id "ab"
+
+    def test_subscribe_packet_bytes(self):
+        from nnstreamer_tpu.edge import mqtt_wire as mw
+        pkt = mw.subscribe_packet(1, ["a/b"])
+        assert pkt == bytes.fromhex(
+            "82"        # SUBSCRIBE with required flags 0b0010
+            "08"        # remaining length
+            "0001"      # packet id
+            "0003612f62"  # topic filter "a/b"
+            "00")       # requested qos 0
+
+    def test_publish_packet_bytes(self):
+        from nnstreamer_tpu.edge import mqtt_wire as mw
+        pkt = mw.publish_packet("t", b"\x01\x02")
+        assert pkt == bytes.fromhex("30" "05" "000174" "0102")
+
+    def test_varint_boundaries(self):
+        from nnstreamer_tpu.edge import mqtt_wire as mw
+        import io
+        for n, enc in ((0, b"\x00"), (127, b"\x7f"),
+                       (128, b"\x80\x01"), (16383, b"\xff\x7f"),
+                       (16384, b"\x80\x80\x01"),
+                       (268_435_455, b"\xff\xff\xff\x7f")):
+            assert mw.encode_varint(n) == enc
+            assert mw.decode_varint(io.BytesIO(enc).read) == n
+
+    def test_topic_filter_semantics(self):
+        from nnstreamer_tpu.edge.mqtt_wire import topic_matches
+        assert topic_matches("a/+/c", "a/b/c")
+        assert not topic_matches("a/+/c", "a/b/d")
+        assert topic_matches("a/#", "a/b/c/d")
+        assert not topic_matches("a/#", "b")
+        assert not topic_matches("a/+", "a/b/c")
+
+    def test_msg_hdr_layout(self):
+        """The payload header must be exactly the reference's 1024-byte
+        GstMQTTMessageHdr (mqttcommon.h:49-63): num_mems@0,
+        size_mems[16]@8, epochs@136, caps@176."""
+        import struct as st
+        from nnstreamer_tpu.edge import mqtt_wire as mw
+        hdr = mw.pack_msg_hdr([7, 9], "caps-str", 111, 222, 5, None, 42)
+        assert len(hdr) == 1024
+        assert st.unpack_from("<I", hdr, 0)[0] == 2
+        assert st.unpack_from("<QQ", hdr, 8) == (7, 9)
+        assert st.unpack_from("<qq", hdr, 136) == (111, 222)
+        assert st.unpack_from("<QQQ", hdr, 152) == (
+            5, mw.CLOCK_TIME_NONE, 42)
+        assert hdr[176:176 + 9] == b"caps-str\x00"
+        sizes, caps, base, sent, dur, dts, pts = mw.unpack_msg_hdr(hdr)
+        assert (sizes, caps, base, sent, dur, dts, pts) == (
+            [7, 9], "caps-str", 111, 222, 5, None, 42)
+
+
+def test_interop_with_real_broker_if_present():
+    """When a system mosquitto is running on :1883, round-trip through
+    it (≙ reference tests/check_broker.sh gate); skip gracefully."""
+    import pytest
+    from nnstreamer_tpu.edge import mqtt_wire as mw
+    try:
+        probe = mw.MqttClient("localhost", 1883, "nns-probe", timeout=1.0)
+        probe.close()
+    except OSError:
+        pytest.skip("no MQTT broker on localhost:1883")
+    sub = parse_launch(
+        'mqttsrc port=1883 sub-topic=nns/test timeout=10 '
+        '! appsink name=out')
+    sub.start()
+    time.sleep(0.3)
+    pub = parse_launch(
+        f'appsrc name=in caps="{CAPS}" '
+        '! mqttsink pub-topic=nns/test port=1883')
+    pub.start()
+    time.sleep(0.1)
+    pub["in"].push_buffer(Buffer.from_arrays([np.full(4, 8.0, np.float32)]))
+    deadline = time.monotonic() + 10
+    while not sub["out"].buffers and time.monotonic() < deadline:
+        time.sleep(0.05)
+    pub["in"].end_stream()
+    pub.stop()
+    sub.stop()
+    assert len(sub["out"].buffers) == 1
+    np.testing.assert_array_equal(sub["out"].buffers[0].chunks[0].host(),
+                                  np.full(4, 8.0, np.float32))
 
 
 def test_sntp_query_against_fake_server():
