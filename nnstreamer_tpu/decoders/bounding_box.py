@@ -79,7 +79,7 @@ def draw_boxes(boxes: List[DetectedBox], width: int, height: int,
         canvas[max(0, y1 - t + 1):y1 + 1, x0:x1 + 1] = color
         canvas[y0:y1 + 1, x0:x0 + t] = color
         canvas[y0:y1 + 1, max(0, x1 - t + 1):x1 + 1] = color
-        if labels and b.cls < len(labels):
+        if labels and 0 <= b.cls < len(labels):
             ty = y0 - GLYPH_H - 2
             draw_text(canvas, x0, ty if ty >= 0 else y0 + t + 1,
                       labels[b.cls], color)
@@ -260,23 +260,39 @@ class BoundingBoxes(DecoderPlugin):
     def _boxes_ssd_pp(self, buf: Buffer) -> List[DetectedBox]:
         """TFLite detection-postprocess convention: boxes [N,4]
         (ymin,xmin,ymax,xmax normalized), classes [N], scores [N],
-        count [1] (≙ mobilenetssdpp.cc tensor order, option3 reorders)."""
-        order = [int(i) for i in self.option(3).split(":")] \
-            if self.option(3) else [0, 1, 2, 3]
-        chunks = [buf.chunks[i].host() for i in order]
-        boxes, classes, scores, count = chunks
+        count [1] (≙ mobilenetssdpp.cc tensor order, option3 reorders).
+
+        A SINGLE flat chunk of 6K+1 floats is the packed variant
+        (zoo://ssd_mobilenet_v2?packed=1): [4K boxes][K classes]
+        [K scores][1 count] — one D2H instead of four."""
+        if len(buf.chunks) == 1:
+            flat = buf.chunks[0].host().reshape(-1)
+            if (flat.size - 1) % 6:
+                raise ValueError(
+                    "bounding_boxes: single-chunk ssd-postprocess input "
+                    f"of {flat.size} floats is not the packed [6K+1] "
+                    "layout (boxes/classes/scores/count)")
+            k = (flat.size - 1) // 6
+            boxes = flat[:4 * k]
+            classes = flat[4 * k:5 * k]
+            scores = flat[5 * k:6 * k]
+            count = flat[6 * k:]
+        else:
+            order = [int(i) for i in self.option(3).split(":")] \
+                if self.option(3) else [0, 1, 2, 3]
+            chunks = [buf.chunks[i].host() for i in order]
+            boxes, classes, scores, count = chunks
         n = int(count.reshape(-1)[0])
         boxes = boxes.reshape(-1, 4)
-        out = []
-        for i in range(min(n, len(boxes))):
-            s = float(scores.reshape(-1)[i])
-            if s < self.conf_threshold:
-                continue
-            ymin, xmin, ymax, xmax = boxes[i]
-            out.append(DetectedBox(float(xmin), float(ymin),
-                                   float(xmax - xmin), float(ymax - ymin),
-                                   int(classes.reshape(-1)[i]), s))
-        return out
+        scores = scores.reshape(-1)
+        classes = classes.reshape(-1)
+        n = min(n, len(boxes))
+        keep = np.nonzero(scores[:n] >= self.conf_threshold)[0]
+        return [DetectedBox(float(boxes[i, 1]), float(boxes[i, 0]),
+                            float(boxes[i, 3] - boxes[i, 1]),
+                            float(boxes[i, 2] - boxes[i, 0]),
+                            int(classes[i]), float(scores[i]))
+                for i in keep]
 
     def _boxes_mobilenet_ssd(self, buf: Buffer) -> List[DetectedBox]:
         """Raw SSD head + box-prior anchors: tensor0 = box deltas
@@ -303,6 +319,27 @@ class BoundingBoxes(DecoderPlugin):
                            float(score[i]))
                for i in np.nonzero(keep)[0]]
         return nms(out, self.iou_threshold)
+
+    def _boxes_ov_person(self, buf: Buffer) -> List[DetectedBox]:
+        """OpenVINO person-detection: one tensor of up to 200 rows of 7
+        values [image_id, label, conf, x_min, y_min, x_max, y_max]
+        (normalized corners). Scanning stops at the first image_id < 0;
+        rows below the fixed 0.8 confidence are skipped, and kept boxes
+        report class_id -1 / prob 1 — mirroring the reference exactly
+        (≙ ovdetection.cc _get_persons_ov, conf threshold :19)."""
+        rows = buf.chunks[0].host().reshape(-1, 7).astype(np.float32)
+        out: List[DetectedBox] = []
+        for r in rows[:200]:
+            # int-truncating sentinel compare, like the reference's
+            # `(int) desc.image_id < 0` (so -0.5 does NOT stop the scan)
+            if int(r[0]) < 0:
+                break
+            if r[2] < 0.8:
+                continue
+            out.append(DetectedBox(float(r[3]), float(r[4]),
+                                   float(r[5] - r[3]), float(r[6] - r[4]),
+                                   -1, 1.0))
+        return out
 
     def _boxes_mp_palm(self, buf: Buffer) -> List[DetectedBox]:
         """MediaPipe palm detection: tensor0 = boxes [N, >=4] (pixel
@@ -336,6 +373,8 @@ class BoundingBoxes(DecoderPlugin):
             boxes = self._boxes_mobilenet_ssd(buf)
         elif self.mode == "mp-palm-detection":
             boxes = self._boxes_mp_palm(buf)
+        elif self.mode == "ov-person-detection":
+            boxes = self._boxes_ov_person(buf)
         else:
             raise ValueError(f"bounding_boxes: unknown mode {self.mode!r}")
         frame = draw_boxes(boxes, self.out_w, self.out_h,
@@ -344,7 +383,7 @@ class BoundingBoxes(DecoderPlugin):
         out.extras["boxes"] = [
             {"x": b.x, "y": b.y, "w": b.w, "h": b.h, "class": b.cls,
              "label": (self._labels[b.cls] if self._labels and
-                       b.cls < len(self._labels) else str(b.cls)),
+                       0 <= b.cls < len(self._labels) else str(b.cls)),
              "score": b.score}
             for b in boxes]
         return out

@@ -245,6 +245,10 @@ class TensorQueryServerSrc(SrcElement):
         out.extras["batch_rows"] = [
             (b.extras.get("client_id"), b.extras.get("server_id", self.id),
              b.pts) for b in bufs]
+        # downstream device elements slice padded rows off BEFORE any
+        # D2H (tensor_filter honors this) — the tunnel's device->host
+        # link is the scarce resource, don't spend it on padding
+        out.extras["batch_valid_rows"] = len(bufs)
         return out
 
 

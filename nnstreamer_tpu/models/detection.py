@@ -108,8 +108,15 @@ class SSDMobileNetV2(nn.Module):
 
 @register_model("ssd_mobilenet_v2")
 def _build_ssd(width: str = "1.0", num_classes: str = "91",
-               size: str = "300", topk: str = "100", seed: str = "0"):
+               size: str = "300", topk: str = "100", seed: str = "0",
+               packed: str = "0"):
+    """``packed=1`` concatenates the ssd-pp quad into ONE flat float32
+    tensor [6K+1] inside the jitted graph (free on device), so a host
+    consumer pays a single D2H instead of four — on a tunneled chip each
+    synchronous D2H costs ~10 ms of latency. The bounding_boxes decoder
+    unpacks the layout transparently."""
     w, nc, hw, k = float(width), int(num_classes), int(size), int(topk)
+    want_packed = packed not in ("0", "", "false")
     model = SSDMobileNetV2(num_classes=nc, width=w, topk=k)
     dummy = jnp.zeros((1, hw, hw, 3), jnp.bfloat16)
     params = model.init(jax.random.PRNGKey(int(seed)), dummy)
@@ -117,6 +124,9 @@ def _build_ssd(width: str = "1.0", num_classes: str = "91",
     def apply_one(p, frame):
         x = frame.astype(jnp.bfloat16) / 127.5 - 1.0
         boxes, classes, scores, count = model.apply(p, x[None])
+        if want_packed:
+            return jnp.concatenate([boxes.reshape(-1), classes,
+                                    scores, count])
         return boxes, classes, scores, count
 
     def apply_fn(p, frame):
@@ -125,8 +135,9 @@ def _build_ssd(width: str = "1.0", num_classes: str = "91",
         return apply_one(p, frame)
 
     in_info = TensorsInfo.make("uint8", f"3:{hw}:{hw}")
-    out_info = TensorsInfo.make(
-        "float32,float32,float32,float32", f"4:{k},{k},{k},1")
+    out_info = TensorsInfo.make("float32", str(6 * k + 1)) if want_packed \
+        else TensorsInfo.make(
+            "float32,float32,float32,float32", f"4:{k},{k},{k},1")
     return apply_fn, params, in_info, out_info
 
 
@@ -193,10 +204,17 @@ def _build_deeplab(width: str = "1.0", size: str = "257",
                    argmax: str = "0"):
     """``argmax=1`` folds the per-pixel argmax into the XLA program and
     emits the int32 [H, W] class map instead of [H, W, C] logits — 21x
-    less D2H traffic; image_segment consumes either form (like the
-    tflite deeplab variants that end in ArgMax)."""
+    less D2H traffic; ``argmax=u8`` goes further and emits uint8 (class
+    count is <=255 by construction), another 4x off the host link.
+    image_segment consumes any form (like the tflite deeplab variants
+    that end in ArgMax)."""
     w, hw, nc = float(width), int(size), int(num_classes)
     want_argmax = argmax not in ("0", "", "false")
+    argmax_dtype = jnp.uint8 if argmax == "u8" else jnp.int32
+    if argmax == "u8" and nc > 255:
+        raise ValueError(
+            f"deeplab_v3: argmax=u8 cannot represent {nc} classes; "
+            "use argmax=1 (int32)")
     model = DeepLabV3(num_classes=nc, width=w, out_size=hw)
     dummy = jnp.zeros((1, hw, hw, 3), jnp.bfloat16)
     params = model.init(jax.random.PRNGKey(int(seed)), dummy)
@@ -206,10 +224,11 @@ def _build_deeplab(width: str = "1.0", size: str = "257",
         x = frame.astype(jnp.bfloat16) / 127.5 - 1.0
         out = model.apply(p, x if batched else x[None])
         if want_argmax:
-            out = jnp.argmax(out, axis=-1).astype(jnp.int32)
+            out = jnp.argmax(out, axis=-1).astype(argmax_dtype)
         return out if batched else out[0]
 
     in_info = TensorsInfo.make("uint8", f"3:{hw}:{hw}")
-    out_info = TensorsInfo.make("int32", f"{hw}:{hw}") if want_argmax \
-        else TensorsInfo.make("float32", f"{nc}:{hw}:{hw}")
+    out_info = TensorsInfo.make(
+        "uint8" if argmax == "u8" else "int32", f"{hw}:{hw}") \
+        if want_argmax else TensorsInfo.make("float32", f"{nc}:{hw}:{hw}")
     return apply_fn, params, in_info, out_info

@@ -20,14 +20,30 @@ def save_params(path: str, params: Any) -> None:
 
 def restore_params(path: str, like: Any = None) -> Any:
     """Restore params saved by :func:`save_params`. ``like`` provides the
-    target structure/shardings (restores as-saved when None)."""
+    target structure/shardings (restores as-saved when None).
+
+    When ``like`` leaves are jax.Arrays their shardings are passed as
+    explicit restore args, so a mesh-resident tree restores straight
+    onto its mesh — no orbax "Sharding info not provided ... unsafe when
+    restoring on a different topology" path, no host round trip."""
     import orbax.checkpoint as ocp
     path = os.path.abspath(path)
     ckptr = ocp.PyTreeCheckpointer()
     target = os.path.join(path, "params")
     if like is not None:
         import jax
-        restored = ckptr.restore(target, item=like)
+
+        def rarg(leaf):
+            if isinstance(leaf, jax.Array):
+                return ocp.ArrayRestoreArgs(
+                    sharding=leaf.sharding,
+                    global_shape=leaf.shape,
+                    dtype=leaf.dtype)
+            return ocp.RestoreArgs()
+
+        restored = ckptr.restore(
+            target, item=like,
+            restore_args=jax.tree_util.tree_map(rarg, like))
     else:
         restored = ckptr.restore(target)
     return restored

@@ -102,7 +102,17 @@ class JaxTrainer(TrainerFramework):
             raise ValueError(f"jax trainer cannot load model-config {cfg!r}")
         if props.model_load_path:
             from .checkpoint import restore_params
-            self.params = restore_params(props.model_load_path, self.params)
+            like = self.params
+            if props.mesh:
+                # place the template on the mesh FIRST so the restore
+                # lands directly sharded (explicit restore args, no
+                # orbax topology warning, no host round trip)
+                from ..parallel.mesh import mesh_from_spec
+                from ..parallel.sharding import rules_by_name, shard_params
+                like = shard_params(self.params,
+                                    rules_by_name(props.rules or ""),
+                                    mesh_from_spec(props.mesh))
+            self.params = restore_params(props.model_load_path, like)
 
     def start(self) -> None:
         self._stop_evt.clear()

@@ -225,6 +225,33 @@ class TestMpPalmDetection:
                                                 abs=1e-5)
 
 
+class TestOvPersonDetection:
+    def test_rows_threshold_and_sentinel(self):
+        """Rows of 7 [image_id, label, conf, x0, y0, x1, y1]; scan stops
+        at image_id<0, conf<0.8 skipped, kept boxes are class -1/prob 1
+        (≙ ovdetection.cc _get_persons_ov)."""
+        from nnstreamer_tpu.decoders.registry import find_decoder
+        from nnstreamer_tpu.tensors.buffer import Buffer
+        dec = find_decoder("bounding_boxes")()
+        dec.set_options(["ov-person-detection", "", "", "100:100",
+                         "100:100", "", "", "", ""])
+        rows = np.array([
+            [0, 1, 0.9, 0.1, 0.2, 0.5, 0.6],   # kept
+            [0, 1, 0.5, 0.0, 0.0, 1.0, 1.0],   # below 0.8 -> skipped
+            [0, 1, 0.95, 0.3, 0.3, 0.4, 0.9],  # kept
+            [-1, 0, 0.99, 0.0, 0.0, 1.0, 1.0],  # sentinel: stop
+            [0, 1, 0.99, 0.0, 0.0, 1.0, 1.0],  # never reached
+        ], np.float32)
+        out = dec.decode(Buffer.from_arrays([rows]))
+        got = out.extras["boxes"]
+        assert len(got) == 2
+        assert got[0]["x"] == pytest.approx(0.1)
+        assert got[0]["w"] == pytest.approx(0.4)
+        assert got[0]["class"] == -1 and got[0]["score"] == 1.0
+        assert got[1]["y"] == pytest.approx(0.3)
+        assert got[1]["h"] == pytest.approx(0.6)
+
+
 class TestFont:
     def test_draw_text_marks_pixels(self):
         from nnstreamer_tpu.decoders.font import draw_text

@@ -189,3 +189,32 @@ def test_query_microbatch_lands_sharded_on_mesh():
                                    rtol=1e-4, atol=1e-4)
     assert n_invokes < n_frames, (n_invokes, n_frames)
     assert any(sig[0][0] == (4, 8, 64) for sig in sigs), sigs
+
+
+def test_filter_slices_padded_rows_of_host_outputs():
+    """batch_valid_rows: padded micro-batch rows of HOST outputs are
+    dropped (free numpy view) before they hit the wire; device outputs
+    keep their padding (an extra eager slice op costs a tunnel RPC — the
+    serversink demux drops the rows instead)."""
+    from nnstreamer_tpu.pipeline.registry import make_element
+    from nnstreamer_tpu.tensors.buffer import Buffer as B, Chunk
+    f = make_element("tensor_filter", framework="jax",
+                     model="zoo://mlp?dtype=float32")
+    got = []
+    f.start()
+
+    class HostFw:
+        def invoke(self, inputs):
+            return [np.ones((4, 10), np.float32)]
+
+    f.fw = HostFw()
+    f.srcpad.push = got.append  # capture without a downstream element
+    x = np.random.RandomState(0).randn(4, 8, 64).astype(np.float32)
+    buf = B([Chunk(x)])
+    buf.extras["batch_valid_rows"] = 2
+    buf.extras["batch_rows"] = [(0, 0, None), (1, 0, None)]
+    f.do_chain(f.sinkpad, buf)
+    f.fw = None
+    f.stop()
+    assert len(got) == 1
+    assert got[0].chunks[0].shape[0] == 2  # padded rows 2..3 never ship

@@ -92,3 +92,30 @@ def test_zoo_ssd_batch_polymorphic():
                           np.uint8, endpoint=True)
     outs = apply_fn(params, frames)
     assert all(np.asarray(o).shape[0] == 2 for o in outs)
+
+
+def test_zoo_ssd_packed_matches_quad():
+    """packed=1 is the quad flattened in [4K boxes][K cls][K scores]
+    [1 count] order, and the bounding_boxes decoder reads either form
+    identically."""
+    from nnstreamer_tpu.models import zoo
+    from nnstreamer_tpu.decoders.registry import find_decoder
+    from nnstreamer_tpu.tensors.buffer import Buffer, Chunk
+    rng = np.random.default_rng(2)
+    quad_fn, params, in_info, _ = zoo.build("ssd_mobilenet_v2",
+                                            size="96", topk="10")
+    packed_fn, params2, _, out_info = zoo.build(
+        "ssd_mobilenet_v2", size="96", topk="10", packed="1")
+    frame = rng.integers(0, 255, tuple(in_info[0].shape), np.uint8,
+                         endpoint=True)
+    quad = [np.asarray(o) for o in quad_fn(params, frame)]
+    flat = np.asarray(packed_fn(params, frame))  # same params tree shape
+    assert out_info[0].shape == (61,)
+    np.testing.assert_allclose(
+        flat, np.concatenate([quad[0].reshape(-1), quad[1], quad[2],
+                              quad[3]]), rtol=1e-5, atol=1e-5)
+    dec = find_decoder("bounding_boxes")()
+    dec.set_options(["mobilenet-ssd-postprocess", "", "", "96:96", "96:96"])
+    from_quad = dec._boxes_ssd_pp(Buffer([Chunk(q) for q in quad]))
+    from_flat = dec._boxes_ssd_pp(Buffer([Chunk(flat)]))
+    assert [vars(b) for b in from_flat] == [vars(b) for b in from_quad]

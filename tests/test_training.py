@@ -125,6 +125,50 @@ def test_trainer_resume_from_checkpoint(tmp_path):
     assert loss_b < loss_a  # continued from the saved params
 
 
+def test_mesh_checkpoint_round_trip_resumes_sharded(tmp_path, caplog):
+    """VERDICT r3 item 8: save mesh-trainer params, restore onto the
+    SAME mesh with explicit shardings (no orbax 'Sharding info not
+    provided' topology warning), resume training, loss keeps falling."""
+    import logging
+    import warnings
+
+    import jax
+    data, jpath, _, _ = _write_dataset(tmp_path, n=16)
+    save = tmp_path / "ckpt"
+    desc = (
+        f'datareposrc location={data} json={jpath} is-shuffle=false '
+        'epochs=4 '
+        '! tensor_trainer name=t framework=jax '
+        'model-config="zoo://mlp?in_dim=8&hidden=16&out_dim=4&lr=0.05" '
+        'mesh=4x1x2 rules=gpt '
+        'num-training-samples=16 epochs=4 num-inputs=1 num-labels=1 '
+        f'{{}} ! appsink name=out')
+    pipe = parse_launch(desc.format(f"model-save-path={save}"))
+    pipe.run(timeout=300)
+    pipe.stop()
+    loss_a = pipe["out"].buffers[-1].chunks[0].host()[0]
+    assert (save / "params").exists()
+
+    with warnings.catch_warnings(record=True) as wrecs:
+        warnings.simplefilter("always")
+        with caplog.at_level(logging.WARNING):
+            pipe = parse_launch(desc.format(
+                f"model-save-path={save} model-load-path={save}"))
+            pipe.start()
+            pipe.wait_eos(300)
+            params = pipe["t"].fw.params
+            pipe.stop()
+    texts = [str(w.message) for w in wrecs] + \
+            [r.getMessage() for r in caplog.records]
+    assert not any("Sharding info not provided" in t for t in texts), texts
+    loss_b = pipe["out"].buffers[-1].chunks[0].host()[0]
+    assert loss_b < loss_a  # resumed from the saved mesh state
+    # restored-then-trained params live across the full 8-device mesh
+    leaves = jax.tree_util.tree_leaves(params)
+    devs = {d for l in leaves for d in l.sharding.device_set}
+    assert len(devs) == 8
+
+
 def test_trainer_pipeline_on_mesh(tmp_path):
     """datareposrc -> tensor_trainer on the 8-virtual-device mesh: the
     sharded train step from parallel/train.py must actually run in the

@@ -1,0 +1,71 @@
+#!/usr/bin/env python3
+"""Generate Documentation/elements.md from the live element registry.
+
+≙ the reference's Documentation/component-description.md, but produced
+from the code (PROPS defaults, pad templates, class docstrings) so it
+cannot drift. Re-run after adding elements::
+
+    python tools/gen_element_docs.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import nnstreamer_tpu  # noqa: F401 — registers all elements
+    from nnstreamer_tpu.pipeline.registry import (element_names,
+                                                  get_element_class)
+
+    out = ["# Element reference",
+           "",
+           "Auto-generated from the element registry "
+           "(`python tools/gen_element_docs.py`). Every element is "
+           "usable from the launch CLI: "
+           "`python -m nnstreamer_tpu '<element> prop=value ! ...'`; "
+           "`python -m nnstreamer_tpu --inspect <element>` prints the "
+           "same information live.",
+           ""]
+    for name in element_names():
+        cls = get_element_class(name)
+        doc = (cls.__doc__ or "").strip()
+        out.append(f"## {name}")
+        out.append("")
+        out.append(f"`{cls.__module__}.{cls.__name__}`")
+        out.append("")
+        if doc:
+            out.append(doc)
+            out.append("")
+        props = {}
+        for klass in reversed(cls.__mro__):
+            props.update(getattr(klass, "PROPS", {}))
+        if props:
+            out.append("| property | default |")
+            out.append("|---|---|")
+            for k, v in sorted(props.items()):
+                out.append(f"| `{k}` | `{v!r}` |")
+            out.append("")
+        pads = []
+        for attr, label in (("SINK_TEMPLATES", "sink"),
+                            ("SRC_TEMPLATES", "src")):
+            for pname, caps in (getattr(cls, attr, {}) or {}).items():
+                pads.append(f"| {label} | `{pname}` | {caps or 'ANY'} |")
+        if pads:
+            out.append("| pad | name | caps |")
+            out.append("|---|---|---|")
+            out.extend(pads)
+            out.append("")
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "Documentation", "elements.md")
+    with open(path, "w") as f:
+        f.write("\n".join(out) + "\n")
+    print(f"wrote {os.path.normpath(path)} ({len(element_names())} elements)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
