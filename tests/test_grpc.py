@@ -87,3 +87,55 @@ def test_unknown_idl_rejected():
     with pytest.raises(ValueError, match="unknown idl"):
         p.start()
     p.stop()
+
+
+def test_stock_grpc_client_interop():
+    """A STOCK grpcio client (no framework wrappers) calls
+    /nnstreamer.protobuf.TensorService/SendTensors with a hand-encoded
+    protobuf Tensors message; tensor_src_grpc must serve it over real
+    HTTP/2 gRPC and decode the reference schema byte-for-byte."""
+    import grpc
+
+    port = _free_port()
+    sub = parse_launch(
+        f'tensor_src_grpc server=true port={port} idl=protobuf timeout=15 '
+        '! appsink name=out')
+    sub.start()
+
+    # hand-encoded nnstreamer.proto Tensors (independent of the repo's
+    # protowire codec): num_tensor=1, fr{30/1}, one float32 [4] tensor
+    def tag(field, wire):
+        return bytes([(field << 3) | wire])
+
+    def varint(n):
+        out = b""
+        while True:
+            b7, n = n & 0x7F, n >> 7
+            out += bytes([b7 | (0x80 if n else 0)])
+            if not n:
+                return out
+
+    data = np.array([1.5, -2.0, 3.25, 9.0], np.float32).tobytes()
+    tensor = (tag(1, 2) + varint(2) + b"t0"
+              + tag(2, 0) + varint(7)                  # NNS_FLOAT32
+              + tag(3, 2) + varint(1) + varint(4)      # packed dims [4]
+              + tag(4, 2) + varint(len(data)) + data)
+    fr = tag(1, 0) + varint(30) + tag(2, 0) + varint(1)
+    msg = (tag(1, 0) + varint(1)
+           + tag(2, 2) + varint(len(fr)) + fr
+           + tag(3, 2) + varint(len(tensor)) + tensor)
+
+    ch = grpc.insecure_channel(f"localhost:{port}")
+    send = ch.stream_unary("/nnstreamer.protobuf.TensorService/SendTensors")
+    send(iter([msg]), wait_for_ready=True, timeout=15)
+    deadline = time.monotonic() + 15
+    while not sub["out"].buffers and time.monotonic() < deadline:
+        time.sleep(0.05)
+    ch.close()
+    sub.stop()
+    assert len(sub["out"].buffers) == 1
+    out = sub["out"].buffers[0].chunks[0].host()
+    np.testing.assert_array_equal(
+        out, np.array([1.5, -2.0, 3.25, 9.0], np.float32))
+    cfg = sub["out"].sinkpad.caps.to_config()
+    assert cfg.rate_n == 30 and cfg.info[0].shape == (4,)
