@@ -288,10 +288,13 @@ class TensorFilter(Element):
                        and o.shape[0] == pad and pad > nv else o
                        for o in outputs]
         if self.prefetch_host:
-            for o in outputs:
-                copy_async = getattr(o, "copy_to_host_async", None)
-                if copy_async is not None:
-                    copy_async()
+            # enqueue on the coalescing fetch service: the frame leaves
+            # this element immediately carrying PendingHost handles, and
+            # every frame queued while a fetch RPC is in flight shares
+            # the next one. (copy_to_host_async does NOT hide the tunnel
+            # RTT — measured worse than a plain blocking fetch.)
+            from ..tensors.fetch import submit_fetch
+            outputs = submit_fetch(outputs)
         out_chunks = self._combine_outputs(buf, outputs)
         self.push(buf.with_chunks(out_chunks))
 

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 
 from ..tensors.info import TensorsInfo
 from .mobilenet import ConvBN, MobileNetV2, _V2_BLOCKS, _make_divisible
-from .zoo import register_model
+from .zoo import jit_init, register_model
 
 
 class _Backbone(nn.Module):
@@ -119,7 +119,7 @@ def _build_ssd(width: str = "1.0", num_classes: str = "91",
     want_packed = packed not in ("0", "", "false")
     model = SSDMobileNetV2(num_classes=nc, width=w, topk=k)
     dummy = jnp.zeros((1, hw, hw, 3), jnp.bfloat16)
-    params = model.init(jax.random.PRNGKey(int(seed)), dummy)
+    params = jit_init(model, seed, dummy)
 
     def apply_one(p, frame):
         x = frame.astype(jnp.bfloat16) / 127.5 - 1.0
@@ -160,7 +160,7 @@ def _build_posenet(width: str = "1.0", size: str = "257",
     w, hw, kp = float(width), int(size), int(keypoints)
     model = PoseNet(keypoints=kp, width=w)
     dummy = jnp.zeros((1, hw, hw, 3), jnp.bfloat16)
-    params = model.init(jax.random.PRNGKey(int(seed)), dummy)
+    params = jit_init(model, seed, dummy)
 
     def apply_fn(p, frame):
         batched = frame.ndim == 4
@@ -217,7 +217,7 @@ def _build_deeplab(width: str = "1.0", size: str = "257",
             "use argmax=1 (int32)")
     model = DeepLabV3(num_classes=nc, width=w, out_size=hw)
     dummy = jnp.zeros((1, hw, hw, 3), jnp.bfloat16)
-    params = model.init(jax.random.PRNGKey(int(seed)), dummy)
+    params = jit_init(model, seed, dummy)
 
     def apply_fn(p, frame):
         batched = frame.ndim == 4

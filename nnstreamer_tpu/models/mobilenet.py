@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from ..tensors.info import TensorsInfo
-from .zoo import register_model
+from .zoo import jit_init, register_model
 
 # (expansion t, channels c, repeats n, stride s) — the standard v2 table
 _V2_BLOCKS: Sequence[Tuple[int, int, int, int]] = (
@@ -110,7 +110,7 @@ def _build_mobilenet_v2(width: str = "1.0", num_classes: str = "1001",
     w, nc, hw = float(width), int(num_classes), int(size)
     model = MobileNetV2(num_classes=nc, width=w)
     dummy = jnp.zeros((1, hw, hw, 3), jnp.bfloat16)
-    variables = model.init(jax.random.PRNGKey(int(seed)), dummy)
+    variables = jit_init(model, seed, dummy)
 
     def apply_fn(params, frame):
         # batch-polymorphic: an HWC frame runs as batch-1; a BHWC stack

@@ -55,7 +55,16 @@ class GPTConfig:
 
 
 def init_params(cfg: GPTConfig, key: jax.Array) -> Dict[str, Any]:
-    """Param tree with path names the partition rules key off."""
+    """Param tree with path names the partition rules key off.
+
+    Jitted on ``cfg`` (frozen, hashable): the whole tree materializes in
+    ONE compiled dispatch instead of 9x n_layers eager ops — on a
+    tunneled dev chip each eager op is a full RPC round trip."""
+    return _init_params_jit(cfg, key)
+
+
+@partial(jax.jit, static_argnums=0)
+def _init_params_jit(cfg: GPTConfig, key: jax.Array) -> Dict[str, Any]:
     dt = cfg.dtype
     d, f, v = cfg.d_model, cfg.ff, cfg.vocab
 
@@ -307,6 +316,46 @@ def decode_step_multi(params, cache, token, active, cfg: GPTConfig):
     cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v),
              "index": pos + active.astype(jnp.int32)}
     return logits, cache
+
+
+def decode_chunk_multi(params, cache, logits, keys, active, cfg: GPTConfig,
+                       *, steps: int, temperature: float = 0.0):
+    """``steps`` sample+decode rounds for B streams in ONE dispatch.
+
+    A ``lax.scan`` over :func:`decode_step_multi` with the sampling
+    (greedy argmax, or categorical at ``temperature``) folded into the
+    graph, so token generation costs 1/steps of the dispatches — and,
+    crucially for a remote-attached chip, 1/steps of the host round
+    trips: the caller fetches a [steps, B] token block instead of B ids
+    per step. The per-stream key-split order matches the host-side
+    sampling loop exactly, so chunked and unchunked generation emit
+    identical tokens for the same seed.
+
+    The reference's llamacpp slot has no analog (its decode loop is
+    host-driven per token); this is the XLA-native shape of generation:
+    static chunk length, in-graph control flow (SURVEY.md §7 stance).
+
+    Args: logits [B,V] from prefill or the previous chunk; keys [B,2]
+    uint32 PRNG keys (ignored when temperature==0); active [B] bool.
+    Returns (tokens [steps, B] int32, logits, cache, keys).
+    """
+    def body(carry, _):
+        lg, ca, ks = carry
+        if temperature > 0:
+            pair = jax.vmap(jax.random.split)(ks)      # [B,2,2]
+            ks2, subs = pair[:, 0], pair[:, 1]
+            tok = jax.vmap(lambda k, l: jax.random.categorical(
+                k, l / temperature))(subs, lg)
+        else:
+            ks2 = ks
+            tok = jnp.argmax(lg, -1)
+        tok = tok.astype(jnp.int32)
+        lg2, ca2 = decode_step_multi(params, ca, tok, active, cfg)
+        return (lg2, ca2, ks2), tok
+
+    (logits, cache, keys), toks = jax.lax.scan(
+        body, (logits, cache, keys), None, length=steps)
+    return toks, logits, cache, keys
 
 
 @register_model("gpt")

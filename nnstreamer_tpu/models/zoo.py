@@ -41,6 +41,17 @@ def model_names():
     return sorted(_ZOO)
 
 
+def jit_init(model, seed: str, dummy):
+    """Init a flax module's params in ONE compiled dispatch.
+
+    Eager flax init runs hundreds of tiny ops; on a remote-attached chip
+    each is a full RPC round trip, turning model open into minutes under
+    bad link weather. Jitting the init collapses it into one dispatch.
+    """
+    import jax
+    return jax.jit(model.init)(jax.random.PRNGKey(int(seed)), dummy)
+
+
 @register_model("mlp")
 def _build_mlp(in_dim: str = "64", hidden: str = "128", out_dim: str = "10",
                seed: str = "0", dtype: str = "bfloat16"):

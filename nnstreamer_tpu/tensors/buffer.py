@@ -36,7 +36,9 @@ class BufferFlags(enum.IntFlag):
 
 
 class Chunk:
-    """One tensor memory: a host ndarray or a device jax.Array.
+    """One tensor memory: a host ndarray, a device jax.Array, or a
+    :class:`~..tensors.fetch.PendingHost` (a D2H fetch in flight, started
+    by the filter's prefetch-host pool).
 
     ``meta`` is present on flexible/sparse streams (self-describing header,
     ref: GstTensorMetaInfo); static streams rely on negotiated caps.
@@ -48,19 +50,42 @@ class Chunk:
         self._data = data
         self.meta = meta
 
+    def _settle(self) -> Any:
+        """Resolve an in-flight fetch (blocking) and cache the result."""
+        from .fetch import PendingHost
+        d = self._data
+        if isinstance(d, PendingHost):
+            d = self._data = d.resolve()
+        return d
+
     # -- residency --------------------------------------------------------
     @property
     def is_device(self) -> bool:
-        return not isinstance(self._data, (np.ndarray, bytes, bytearray, memoryview))
+        from .fetch import PendingHost
+        d = self._data
+        if isinstance(d, PendingHost):
+            # still device-reachable until the fetch lands: chained
+            # device-side elements keep HBM residency without waiting
+            return d.dev is not None and not d.done
+        return not isinstance(d, (np.ndarray, bytes, bytearray, memoryview))
 
     @property
     def raw(self) -> Any:
-        """The underlying array, wherever it lives (no transfer)."""
-        return self._data
+        """The underlying array, wherever it lives. For a chunk whose
+        host fetch is in flight this is non-blocking while the device
+        array is still reachable (device consumers proceed in HBM);
+        otherwise it blocks for the fetched host copy."""
+        from .fetch import PendingHost
+        d = self._data
+        if isinstance(d, PendingHost):
+            if not d.done and d.dev is not None:
+                return d.dev
+            d = self._data = d.resolve()
+        return d
 
     def host(self) -> np.ndarray:
         """Materialize to a host ndarray (D2H transfer if device-resident)."""
-        d = self._data
+        d = self._settle()
         if isinstance(d, np.ndarray):
             return d
         if isinstance(d, (bytes, bytearray, memoryview)):
@@ -70,10 +95,16 @@ class Chunk:
     def device(self, device=None, sharding=None):
         """Materialize on device (H2D transfer if host-resident)."""
         import jax
+        from .fetch import PendingHost
         d = self._data
+        if isinstance(d, PendingHost):
+            # prefer the still-live device array: no wait, no H2D
+            d = d.dev if d.dev is not None else self._settle()
         if _is_device_array(d) and device is None and sharding is None:
             return d
-        return jax.device_put(self.host() if not _is_device_array(d) else d,
+        if not _is_device_array(d) and not isinstance(d, np.ndarray):
+            d = self.host()
+        return jax.device_put(d,
                               sharding if sharding is not None else device)
 
     # -- shape/dtype ------------------------------------------------------
