@@ -174,6 +174,58 @@ def bench_deeplab():
     return fps, p50
 
 
+def bench_llm_decode(n_prompts: int = 8, streams: int = 4,
+                     chunk: int = 16, max_tokens: int = 64):
+    """Generative slot: aggregate decode tokens/s. Continuous batching
+    (n_parallel slots, prompts admitted as slots free) x chunked scan
+    decode (custom=chunk:K -> K sample+decode rounds per dispatch, K
+    tokens per host fetch). The llamacpp slot of the reference is
+    host-driven per token; this row shows the XLA-native decode loop."""
+    from nnstreamer_tpu.filters.base import FilterProperties
+    from nnstreamer_tpu.filters.registry import find_filter
+
+    zoo = "zoo://gpt?vocab=8192&d_model=512&n_heads=8&n_layers=8"
+    fw = find_filter("llm")()
+    fw.open(FilterProperties(
+        model_files=(zoo,), invoke_async=True,
+        custom_properties=(f"max_tokens:{max_tokens},n_parallel:{streams},"
+                           f"max_len:128,chunk:{chunk}")))
+    total = n_prompts * max_tokens
+    got = {"n": 0, "t0": None, "t1": None}
+    lk = threading.Lock()
+    done = threading.Event()
+
+    import numpy as np
+
+    def dispatch(outputs, ctx=None):
+        if ctx == "w":      # late warmup tokens must not skew the count
+            return
+        with lk:
+            if got["t0"] is None:
+                got["t0"] = time.perf_counter()
+            got["n"] += 1
+            if got["n"] == total:
+                got["t1"] = time.perf_counter()
+                done.set()
+
+    # warmup prompt compiles prefill + chunk executables
+    warm = threading.Event()
+    fw.set_async_dispatcher(
+        lambda o, ctx=None: warm.set() if ctx == "w" else None)
+    fw.invoke_async([np.arange(8, dtype=np.int32)], ctx="w")
+    warm.wait(timeout=300)
+    time.sleep(1.0)  # drain the warmup stream fully
+    fw.set_async_dispatcher(dispatch)
+    for i in range(n_prompts):
+        fw.invoke_async(
+            [np.arange(1 + (i % 7), dtype=np.int32) + i], ctx=i)
+    ok = done.wait(timeout=600)
+    fw.close()
+    if not ok or got["t1"] is None:
+        raise RuntimeError(f"llm decode produced {got['n']}/{total} tokens")
+    return total / (got["t1"] - got["t0"]), 0.0
+
+
 # profiled on the tunneled v5e: batch=4 + deep client windows beats
 # batch=8 (less padding, more batches in flight to hide D2H latency) —
 # 160 vs 76 fps aggregate
@@ -297,6 +349,12 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001 -- one config must not kill the row
             print(f"# {name} failed: {e}", file=sys.stderr)
             extras[f"{name}_fps"] = None
+    try:
+        toks, _ = bench_llm_decode()
+        extras["llm_decode_tok_s"] = round(toks, 1)
+    except Exception as e:  # noqa: BLE001
+        print(f"# llm_decode failed: {e}", file=sys.stderr)
+        extras["llm_decode_tok_s"] = None
 
     print(json.dumps({
         "metric": "mobilenet_v2_pipeline_fps",

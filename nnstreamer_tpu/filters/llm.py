@@ -99,6 +99,13 @@ class LlmFilter(FilterFramework):
         self._insert = jax.jit(tfm.cache_insert)
         self._tfm = tfm
         self._n_parallel = int(self._opts.get("n_parallel", "1"))
+        # custom=chunk:K folds K sample+decode rounds into one scanned
+        # dispatch (models/transformer.py decode_chunk_multi): dispatches
+        # AND host round trips per token drop K-fold. Token streams are
+        # bit-identical to chunk:1; the tradeoff is admission latency in
+        # n_parallel mode (a new prompt waits for the current chunk).
+        self._chunk = max(1, int(self._opts.get("chunk", "1")))
+        self._chunk_jits: Dict[tuple, Any] = {}
         with self._cond:
             # prompts queued before a close() belong to the previous
             # session (and carry its ctx buffers) — never replay them
@@ -160,6 +167,18 @@ class LlmFilter(FilterFramework):
         self.stats["prefill_dispatches"] += 1
         return logits, cache
 
+    def _chunk_fn(self, steps: int, temperature: float):
+        """Jitted K-step decode chunk, cached per (steps, temperature)."""
+        key = (steps, float(temperature))
+        fn = self._chunk_jits.get(key)
+        if fn is None:
+            import jax
+            tfm, cfg = self._tfm, self._cfg
+            fn = jax.jit(lambda p, c, l, k, a: tfm.decode_chunk_multi(
+                p, c, l, k, a, cfg, steps=steps, temperature=temperature))
+            self._chunk_jits[key] = fn
+        return fn
+
     def _generate(self, prompt: np.ndarray, emit) -> None:
         import jax
         import jax.numpy as jnp
@@ -178,6 +197,10 @@ class LlmFilter(FilterFramework):
         self._check_prompt(prompt, max_len)
         logits, cache = self._prefill_prompt(prompt, max_len)
         pos = prompt.size  # host-side cache index: no per-token device sync
+        if self._chunk > 1:
+            self._generate_chunked(logits, cache, pos, max_tokens, max_len,
+                                   temperature, key, emit)
+            return
         for i in range(max_tokens):
             if self._stop.is_set():
                 return
@@ -193,6 +216,42 @@ class LlmFilter(FilterFramework):
                                          tok.astype(jnp.int32))
             self.stats["decode_dispatches"] += 1
             pos += 1
+
+    def _generate_chunked(self, logits, cache, pos, max_tokens, max_len,
+                          temperature, key, emit) -> None:
+        """Single-stream chunked decode: [chunk] tokens per dispatch and
+        per host fetch. Emits the exact token stream of the per-token
+        loop (same key-split order, same capacity cutoff at max_len)."""
+        import jax
+        import jax.numpy as jnp
+
+        mcache = {"k": cache["k"], "v": cache["v"],
+                  "index": jnp.broadcast_to(cache["index"], (1,))}
+        keys = key[None]
+        active = jnp.ones((1,), bool)
+        remaining = max_tokens
+        while remaining > 0 and not self._stop.is_set():
+            # each scan step samples THEN decodes; decode writes at the
+            # stream's cache index, legal while index <= max_len-1
+            k = min(self._chunk, remaining, max_len - pos)
+            if k <= 0:
+                # cache full: the per-token loop still emits one final
+                # sampled token before stopping — mirror it, no decode
+                if temperature > 0:
+                    key2, sub = jax.random.split(keys[0])
+                    tok = jax.random.categorical(sub, logits / temperature, -1)
+                else:
+                    tok = jnp.argmax(logits, -1)
+                emit(np.asarray(tok, np.int32))
+                return
+            toks, logits, mcache, keys = self._chunk_fn(k, temperature)(
+                self._params, mcache, logits, keys, active)
+            self.stats["decode_dispatches"] += 1
+            toks_host = np.asarray(toks)  # ONE fetch for k tokens
+            for j in range(k):
+                emit(toks_host[j].astype(np.int32))
+            pos += k
+            remaining -= k
 
     def invoke(self, inputs: Sequence[Any]) -> List[Any]:
         """Sync path: return the whole generation as one int32 tensor."""
@@ -290,6 +349,10 @@ class LlmFilter(FilterFramework):
             active_np = np.array([s is not None for s in streams])
             if not active_np.any():
                 continue
+            if self._chunk > 1:
+                logits, cache = self._sched_chunk(
+                    streams, active_np, logits, cache, max_len, temperature)
+                continue
             # -- sample on device, D2H just the M token ids
             if temperature > 0:
                 subs = []
@@ -322,6 +385,49 @@ class LlmFilter(FilterFramework):
                 logits, cache = self._decode_multi(
                     self._params, cache, tok, jnp.asarray(active_np))
                 self.stats["decode_dispatches"] += 1
+
+    def _sched_chunk(self, streams, active_np, logits, cache, max_len,
+                     temperature):
+        """One chunked round of the continuous-batching loop: K
+        sample+decode steps in ONE dispatch, K tokens per stream per
+        host fetch. K adapts to the deepest stream still running, so a
+        stream never emits past its budget; streams that finish
+        mid-chunk have their surplus lane tokens discarded (their lanes
+        compute garbage either way). New prompts admit between chunks —
+        the admission-latency/throughput knob is ``custom=chunk:K``."""
+        import jax
+        import jax.numpy as jnp
+
+        # emits each stream still owes; K serves the deepest one fully
+        emits_left = [min(s["remaining"], max_len - s["pos"] + 1)
+                      if s else 0 for s in streams]
+        k = min(self._chunk, max(emits_left))
+        if temperature > 0:
+            # one cached filler key for idle slots: a fresh eager
+            # PRNGKey per slot per round would cost an RPC each on a
+            # remote-attached chip, eroding the chunking win
+            if not hasattr(self, "_idle_key"):
+                self._idle_key = jax.random.PRNGKey(0)
+            keys = jnp.stack([s["key"] if s else self._idle_key
+                              for s in streams])
+        else:
+            keys = jnp.zeros((len(streams), 2), jnp.uint32)
+        toks, logits, cache, keys = self._chunk_fn(k, temperature)(
+            self._params, cache, logits, keys, jnp.asarray(active_np))
+        self.stats["decode_dispatches"] += 1
+        toks_host = np.asarray(toks)  # [k, M]: ONE fetch for the chunk
+        for slot, s in enumerate(streams):
+            if s is None:
+                continue
+            for j in range(min(k, emits_left[slot])):
+                self._dispatch([toks_host[j, slot:slot + 1]], s["ctx"])
+                s["remaining"] -= 1
+                s["pos"] += 1
+            if temperature > 0:
+                s["key"] = keys[slot]
+            if s["remaining"] <= 0 or s["pos"] > max_len:
+                streams[slot] = None
+        return logits, cache
 
 
 register_alias("llamacpp", "llm")

@@ -310,3 +310,119 @@ def test_prefill_length_bucketing_reuses_compilation():
     # lengths 5, 7, 6 all pad to the 8-bucket: exactly one compilation
     assert fw._prefill._cache_size() == 1
     fw.close()
+
+
+# -- chunked decode (custom=chunk:K) ----------------------------------------
+
+def _gen_tokens(custom: str, prompt: np.ndarray) -> np.ndarray:
+    from nnstreamer_tpu.filters.base import FilterProperties
+    from nnstreamer_tpu.filters.registry import find_filter
+    fw = find_filter("llm")()
+    fw.open(FilterProperties(model_files=(ZOO,), custom_properties=custom))
+    out = fw.invoke([prompt])[0]
+    stats = dict(fw.stats)
+    fw.close()
+    return out, stats
+
+
+def test_chunked_greedy_matches_per_token():
+    """chunk:K emits the EXACT token stream of chunk:1 (greedy), with
+    K-fold fewer decode dispatches."""
+    p = np.array([3, 1, 4], np.int32)
+    ref, ref_stats = _gen_tokens("max_tokens:12,max_len:32", p)
+    got, got_stats = _gen_tokens("max_tokens:12,max_len:32,chunk:4", p)
+    np.testing.assert_array_equal(got, ref)
+    assert ref_stats["decode_dispatches"] == 11   # per-token loop
+    assert got_stats["decode_dispatches"] == 3    # ceil(12/4) scans
+
+
+def test_chunked_sampling_matches_per_token():
+    """Same seed + temperature: in-graph sampling reproduces the host
+    sampling loop's key-split order token-for-token."""
+    p = np.array([7, 7], np.int32)
+    ref, _ = _gen_tokens("max_tokens:10,max_len:32,temperature:0.8,seed:3", p)
+    got, _ = _gen_tokens(
+        "max_tokens:10,max_len:32,temperature:0.8,seed:3,chunk:4", p)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_chunked_max_len_cutoff_matches_per_token():
+    """Capacity cutoff (cache full before max_tokens) emits the same
+    final-token tail in chunked mode."""
+    p = np.array([2, 5, 6], np.int32)
+    # max_len 8: prompt 3 -> 5 decodes possible, 6 emits
+    ref, _ = _gen_tokens("max_tokens:16,max_len:8", p)
+    got, _ = _gen_tokens("max_tokens:16,max_len:8,chunk:4", p)
+    np.testing.assert_array_equal(got, ref)
+    assert len(ref) == 6
+
+
+def test_chunked_batched_decode_matches_reference():
+    """n_parallel + chunk: two concurrent streams, K tokens per shared
+    dispatch, each stream still matching its single-stream reference."""
+    from nnstreamer_tpu.filters.base import FilterProperties
+    from nnstreamer_tpu.filters.registry import find_filter
+    fw = find_filter("llm")()
+    fw.open(FilterProperties(
+        model_files=(ZOO,), invoke_async=True,
+        custom_properties="max_tokens:8,n_parallel:2,max_len:32,chunk:4"))
+    got, done = {}, {}
+
+    def dispatch(outputs, ctx=None):
+        got.setdefault(ctx, []).append(int(outputs[0][0]))
+        if len(got[ctx]) == 8:
+            done[ctx] = True
+
+    fw.set_async_dispatcher(dispatch)
+    p1 = np.array([1, 2, 3], np.int32)
+    p2 = np.array([40, 41, 42, 43, 44], np.int32)
+    fw.invoke_async([p1], ctx="a")
+    fw.invoke_async([p2], ctx="b")
+    deadline = time.monotonic() + 120
+    while len(done) < 2 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    n_decode = fw.stats["decode_dispatches"]
+    assert len(done) == 2
+    fw.close()
+    # 8 tokens at chunk 4 = 2 chunks when co-resident (+2 slack for
+    # admission skew: a stream admitted mid-chunk pays its own chunks)
+    assert n_decode <= 4, n_decode
+    ref, _ = _gen_tokens("max_tokens:8,max_len:32", p1)
+    np.testing.assert_array_equal(got["a"], ref)
+    ref, _ = _gen_tokens("max_tokens:8,max_len:32", p2)
+    np.testing.assert_array_equal(got["b"], ref)
+
+
+def test_chunked_batched_sampling_reproducible():
+    """chunk + n_parallel + temperature: per-stream keys survive chunk
+    boundaries; tokens match the single-stream sampling reference."""
+    from nnstreamer_tpu.filters.base import FilterProperties
+    from nnstreamer_tpu.filters.registry import find_filter
+    fw = find_filter("llm")()
+    fw.open(FilterProperties(
+        model_files=(ZOO,), invoke_async=True,
+        custom_properties=("max_tokens:6,n_parallel:2,max_len:32,"
+                           "chunk:4,temperature:0.7,seed:5")))
+    got, done = {}, {}
+
+    def dispatch(outputs, ctx=None):
+        got.setdefault(ctx, []).append(int(outputs[0][0]))
+        if len(got[ctx]) == 6:
+            done[ctx] = True
+
+    fw.set_async_dispatcher(dispatch)
+    p1 = np.array([11, 12], np.int32)
+    p2 = np.array([21, 22, 23], np.int32)
+    fw.invoke_async([p1], ctx="a")
+    fw.invoke_async([p2], ctx="b")
+    deadline = time.monotonic() + 120
+    while len(done) < 2 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert len(done) == 2
+    fw.close()
+    ref, _ = _gen_tokens(
+        "max_tokens:6,max_len:32,temperature:0.7,seed:5", p1)
+    np.testing.assert_array_equal(got["a"], ref)
+    ref, _ = _gen_tokens(
+        "max_tokens:6,max_len:32,temperature:0.7,seed:5", p2)
+    np.testing.assert_array_equal(got["b"], ref)
