@@ -189,8 +189,12 @@ class _Endpoint:
                 f"{self.host}:{self.port}") from e
         svc = _service_name(self.idl)
         if receiving:
+            # the Empty request must be a VALID message of the IDL:
+            # protobuf's Empty is zero bytes, flatbuf's is a real root
+            # table a stock generated server deserializes
+            empty = _FLATBUF_EMPTY if self.idl == "flatbuf" else b""
             call = self._channel.unary_stream(f"/{svc}/RecvTensors")(
-                b"", wait_for_ready=True)
+                empty, wait_for_ready=True)
 
             def pump():
                 try:
@@ -363,7 +367,14 @@ class GrpcSink(SinkElement):
                         break
                     ep.peers_changed.wait(timeout=0.1)
         if ep.send(payload) == 0 and not self.silent:
-            logger.warning("%s: no connected peer, frame dropped", self.name)
+            # distinguish the two drop causes: backpressure (peer alive
+            # but its stream queue is full) vs genuinely no consumer
+            if ep.peer_count():
+                logger.warning("%s: peer stream stalled (send queue "
+                               "full), frame dropped", self.name)
+            else:
+                logger.warning("%s: no connected peer, frame dropped",
+                               self.name)
 
 
 @register_element("tensor_src_grpc")
