@@ -156,21 +156,41 @@ class PoseNet(nn.Module):
 
 @register_model("posenet")
 def _build_posenet(width: str = "1.0", size: str = "257",
-                   keypoints: str = "17", seed: str = "0"):
+                   keypoints: str = "17", seed: str = "0",
+                   decode: str = "0"):
+    """``decode=device`` folds per-keypoint argmax into the XLA program
+    and emits [K, 3] (x, y, score; normalized, pose-decoder "key" form)
+    instead of the [H', W', K] heatmap — ~100x less D2H traffic and no
+    host-side argmax. The decoder's heatmap mode stays the parity path
+    (≙ tensordec-pose.c consumes raw heatmaps); this is the TPU-first
+    option, like deeplab's argmax=u8."""
     w, hw, kp = float(width), int(size), int(keypoints)
+    want_decode = decode not in ("0", "", "false")
     model = PoseNet(keypoints=kp, width=w)
     dummy = jnp.zeros((1, hw, hw, 3), jnp.bfloat16)
     params = jit_init(model, seed, dummy)
+
+    def keypoints_of(hm):
+        hp, wp, k = hm.shape
+        flat = hm.reshape(-1, k)
+        idx = jnp.argmax(flat, axis=0)
+        ys = (idx // wp).astype(jnp.float32) / max(hp - 1, 1)
+        xs = (idx % wp).astype(jnp.float32) / max(wp - 1, 1)
+        scores = jnp.take_along_axis(flat, idx[None], axis=0)[0]
+        return jnp.stack([xs, ys, scores], axis=1)  # [K, 3]
 
     def apply_fn(p, frame):
         batched = frame.ndim == 4
         x = frame.astype(jnp.bfloat16) / 127.5 - 1.0
         out = model.apply(p, x if batched else x[None])
+        if want_decode:
+            out = jax.vmap(keypoints_of)(out)
         return out if batched else out[0]
 
     hm = hw // 16 + (1 if hw % 16 else 0)
     in_info = TensorsInfo.make("uint8", f"3:{hw}:{hw}")
-    out_info = TensorsInfo.make("float32", f"{kp}:{hm}:{hm}")
+    out_info = TensorsInfo.make("float32", f"3:{kp}") if want_decode \
+        else TensorsInfo.make("float32", f"{kp}:{hm}:{hm}")
     return apply_fn, params, in_info, out_info
 
 

@@ -119,3 +119,63 @@ def test_zoo_ssd_packed_matches_quad():
     from_quad = dec._boxes_ssd_pp(Buffer([Chunk(q) for q in quad]))
     from_flat = dec._boxes_ssd_pp(Buffer([Chunk(flat)]))
     assert [vars(b) for b in from_flat] == [vars(b) for b in from_quad]
+
+
+def test_posenet_device_decode_matches_heatmap_positions():
+    """zoo://posenet?decode=device emits [K,3] keypoints whose argmax
+    positions equal the pose decoder's host heatmap decode (scores use
+    the model's already-sigmoided heatmap value, so only positions are
+    compared bit-exactly)."""
+    import numpy as np
+    from nnstreamer_tpu.models import zoo
+
+    apply_hm, params, _, _ = zoo.build("posenet", size="129")
+    apply_kp, params2, _, out_info = zoo.build(
+        "posenet", size="129", decode="device")
+    assert tuple(out_info[0].shape) == (17, 3)
+    frame = np.random.default_rng(3).integers(
+        0, 255, (129, 129, 3), np.uint8, endpoint=True)
+    hm = np.asarray(apply_hm(params, frame))
+    kps = np.asarray(apply_kp(params2, frame))
+    hp, wp, k = hm.shape
+    flat = hm.reshape(-1, k)
+    idx = np.argmax(flat, axis=0)
+    xs = (idx % wp) / (wp - 1)
+    ys = (idx // wp) / (hp - 1)
+    np.testing.assert_allclose(kps[:, 0], xs, atol=1e-6)
+    np.testing.assert_allclose(kps[:, 1], ys, atol=1e-6)
+    np.testing.assert_allclose(kps[:, 2], flat[idx, np.arange(k)],
+                               rtol=1e-5)
+
+
+def test_posenet_device_decode_feeds_pose_decoder():
+    """End-to-end: device-decoded keypoints flow through the
+    pose_estimation decoder's explicit-keypoint path to an RGBA frame."""
+    import threading
+    import numpy as np
+    from nnstreamer_tpu.pipeline.parser import parse_launch
+
+    capsq = ('"other/tensors,format=static,num_tensors=1,'
+             'types=(string)uint8,dimensions=(string)3:129:129,'
+             'framerate=(fraction)0/1"')
+    pipe = parse_launch(
+        f"tensortestsrc caps={capsq} pattern=random num-buffers=3 "
+        '! tensor_filter framework=jax '
+        'model="zoo://posenet?decode=device&size=129" prefetch-host=true '
+        "! tensor_decoder mode=pose_estimation option1=129:129 "
+        "option2=129:129 ! appsink name=out")
+    frames = []
+    done = threading.Event()
+
+    def cb(buf):
+        frames.append(buf)
+        if len(frames) == 3:
+            done.set()
+
+    pipe["out"].connect(cb)
+    pipe.start()
+    assert done.wait(120)
+    pipe.stop()
+    for b in frames:
+        assert b.chunks[0].host().shape == (129, 129, 4)
+        assert len(b.extras["keypoints"]) == 17
