@@ -33,7 +33,9 @@ def sparse_encode(arr: np.ndarray) -> bytes:
     return meta.pack() + idx.tobytes() + vals.tobytes()
 
 
-def sparse_decode(data: bytes) -> np.ndarray:
+def _parse_sparse(data: bytes):
+    """Wire -> (meta, uint32 indices, values); single source of truth
+    for the layout, shared by the host and device decode paths."""
     meta = TensorMetaInfo.unpack(data[:HEADER_SIZE])
     if meta.format != TensorFormat.SPARSE:
         raise ValueError("chunk is not sparse-encoded")
@@ -41,10 +43,14 @@ def sparse_decode(data: bytes) -> np.ndarray:
     off = HEADER_SIZE
     idx = np.frombuffer(data[off:off + 4 * nnz], np.uint32)
     off += 4 * nnz
-    dt = meta.type.np_dtype
-    vals = np.frombuffer(
-        data[off:off + nnz * np.dtype(dt).itemsize], dt)
-    out = np.zeros(math.prod(meta.shape), dt)
+    dt = np.dtype(meta.type.np_dtype)
+    vals = np.frombuffer(data[off:off + nnz * dt.itemsize], dt)
+    return meta, idx, vals
+
+
+def sparse_decode(data: bytes) -> np.ndarray:
+    meta, idx, vals = _parse_sparse(data)
+    out = np.zeros(math.prod(meta.shape), vals.dtype)
     out[idx] = vals
     return out.reshape(meta.shape)
 
@@ -53,16 +59,46 @@ def sparse_decode(data: bytes) -> np.ndarray:
 class TensorSparseEnc(TransformElement):
     SINK_TEMPLATES = {"sink": "other/tensors"}
     SRC_TEMPLATES = {"src": "other/tensors"}
+    # density < 1.0 turns on the DEVICE pack path for device-resident
+    # chunks: non-zeros are packed in HBM (ops/sparse.py) and only
+    # ceil(size*density) (index, value) pairs cross the host link,
+    # not the dense tensor. If a frame's nnz overflows the capacity it
+    # falls back to the host path — never truncates.
+    PROPS = {"density": 1.0}
 
     def transform_caps(self, incaps: Caps) -> Optional[Caps]:
         cfg = incaps.to_config()
         return Caps.from_config(TensorsConfig(
             TensorsInfo(), TensorFormat.SPARSE, cfg.rate_n, cfg.rate_d))
 
+    def _encode_device(self, c: Chunk) -> Optional[bytes]:
+        """Pack on device; None -> caller falls back to the host path."""
+        import jax
+
+        from ..ops.sparse import pack
+
+        dev = c.raw
+        size = int(np.prod(c.shape))
+        capacity = max(1, min(size, math.ceil(size * float(self.density))))
+        idx, vals, nnz = pack(dev.reshape(-1), capacity)
+        idx, vals, nnz = jax.device_get([idx, vals, nnz])
+        nnz = int(nnz)
+        if nnz > capacity:
+            return None  # denser than promised: host path has no limit
+        meta = TensorMetaInfo(
+            type=TensorType.from_dtype(c.dtype), format=TensorFormat.SPARSE,
+            shape=tuple(c.shape), nnz=nnz)
+        return meta.pack() + idx[:nnz].tobytes() + vals[:nnz].tobytes()
+
     def transform(self, buf: Buffer) -> Optional[Buffer]:
         chunks = []
         for c in buf.chunks:
-            data = np.frombuffer(sparse_encode(c.host()), np.uint8)
+            wire = None
+            if float(self.density) < 1.0 and c.is_device:
+                wire = self._encode_device(c)
+            if wire is None:
+                wire = sparse_encode(c.host())
+            data = np.frombuffer(wire, np.uint8)
             meta = TensorMetaInfo.unpack(data[:HEADER_SIZE].tobytes())
             chunks.append(Chunk(data, meta=meta))
         return buf.with_chunks(chunks)
@@ -72,10 +108,35 @@ class TensorSparseEnc(TransformElement):
 class TensorSparseDec(TransformElement):
     SINK_TEMPLATES = {"sink": "other/tensors"}
     SRC_TEMPLATES = {"src": "other/tensors"}
+    # device=true scatters (idx, vals) to a dense tensor IN HBM
+    # (ops/sparse.py unpack): the small pair is what crosses H2D, and a
+    # downstream tensor_filter finds its input already device-resident.
+    PROPS = {"device": False}
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self._out_cfg: Optional[TensorsConfig] = None
+
+    def _decode_device(self, data: bytes) -> Chunk:
+        import jax
+
+        from ..ops.sparse import unpack
+
+        meta, idx, vals = _parse_sparse(data)
+        size = math.prod(meta.shape)
+        # pad to a power-of-two bucket: per-frame nnz varies, and a raw
+        # nnz-shaped input would recompile the jitted scatter every
+        # frame; pads are (idx 0, val 0), which unpack masks out
+        cap = 1
+        while cap < max(len(vals), 1):
+            cap *= 2
+        cap = min(cap, max(size, 1))
+        pad = cap - len(vals)
+        if pad > 0:
+            idx = np.concatenate([idx, np.zeros(pad, np.uint32)])
+            vals = np.concatenate([vals, np.zeros(pad, vals.dtype)])
+        dense = unpack(jax.device_put(idx), jax.device_put(vals), size)
+        return Chunk(dense.reshape(meta.shape))
 
     def transform_caps(self, incaps: Caps) -> Optional[Caps]:
         cfg = incaps.to_config()
@@ -86,7 +147,12 @@ class TensorSparseDec(TransformElement):
             TensorsInfo(), TensorFormat.FLEXIBLE, cfg.rate_n, cfg.rate_d))
 
     def transform(self, buf: Buffer) -> Optional[Buffer]:
-        chunks = [Chunk(sparse_decode(c.host().tobytes())) for c in buf.chunks]
+        if self.device:
+            chunks = [self._decode_device(c.host().tobytes())
+                      for c in buf.chunks]
+        else:
+            chunks = [Chunk(sparse_decode(c.host().tobytes()))
+                      for c in buf.chunks]
         out = buf.with_chunks(chunks)
         if self._out_cfg is None:
             self._out_cfg = TensorsConfig(out.to_infos(), TensorFormat.STATIC,

@@ -1,0 +1,68 @@
+"""Device-side sparse pack/unpack (index+value coding of non-zeros).
+
+SURVEY.md §7's design stance names sparse enc/dec as a custom-kernel
+candidate. The kernel here is a jitted scatter, NOT Pallas — the pallas
+guide's own rule: XLA's scatter/cumsum lowering is already optimal for
+this access pattern, so a hand-written kernel would only add risk. What
+makes it a *device* op is the contract: a device-resident activation is
+packed to (indices, values, nnz) in HBM and only ``capacity`` pairs
+cross the host link, instead of the dense tensor (reference analog:
+gst/nnstreamer/elements/gsttensor_sparse_util.c packs on the host,
+where memory is free).
+
+Capacity is static (XLA needs static shapes): callers size it from an
+expected density bound and fall back to the host path when nnz
+overflows — detected from the returned nnz, never silently truncated.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def pack_reference(arr: np.ndarray):
+    """Numpy oracle: (uint32 indices, values) of non-zeros, flat order."""
+    flat = arr.reshape(-1)
+    idx = np.flatnonzero(flat).astype(np.uint32)
+    return idx, flat[idx]
+
+
+@partial(jax.jit, static_argnums=(1,))
+def pack(flat: jax.Array, capacity: int):
+    """Pack non-zeros of ``flat`` [N] into fixed-size (idx, vals, nnz).
+
+    Returns (idx uint32 [capacity], vals [capacity], nnz int32). Entries
+    past nnz are zero; if nnz > capacity the overflow pairs are DROPPED
+    (scatter mode=drop) — the caller must check nnz and fall back.
+    """
+    nz = flat != 0
+    nnz = nz.sum().astype(jnp.int32)
+    # each non-zero's output slot = its rank among non-zeros (stable)
+    slot = jnp.cumsum(nz) - 1
+    # zeros (and overflow ranks >= capacity) scatter out of bounds -> drop
+    slot = jnp.where(nz, slot, capacity)
+    idx = jnp.zeros((capacity,), jnp.uint32).at[slot].set(
+        jnp.arange(flat.shape[0], dtype=jnp.uint32), mode="drop")
+    vals = jnp.zeros((capacity,), flat.dtype).at[slot].set(
+        flat, mode="drop")
+    return idx, vals, nnz
+
+
+@partial(jax.jit, static_argnums=(2,))
+def unpack(idx: jax.Array, vals: jax.Array, size: int):
+    """Scatter (idx, vals) back to a dense flat [size] on device.
+
+    Padded entries (idx 0 with val 0 past nnz) are harmless: they write
+    val 0 to index 0 after the real writes only if they FOLLOW them in
+    scatter order — so mask them out of bounds instead, using the fact
+    that a padded slot has val==0 AND would collide with slot 0.
+    """
+    n = idx.shape[0]
+    # a pad slot is any slot whose value is zero: writing zero is a
+    # no-op for correctness ONLY if index 0's real value isn't clobbered
+    # -> route pad slots out of bounds (drop)
+    target = jnp.where(vals != 0, idx.astype(jnp.int32), size)
+    return jnp.zeros((size,), vals.dtype).at[target].set(vals, mode="drop")

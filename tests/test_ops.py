@@ -38,3 +38,107 @@ def test_oracle_fallback_off_tpu():
     np.testing.assert_allclose(
         np.asarray(fused_normalize(x), np.float32),
         np.asarray(normalize_reference(x, 1 / 127.5, 127.5), np.float32))
+
+
+class TestSparsePack:
+    """ops/sparse.py: device-side sparse pack/unpack vs the numpy oracle."""
+
+    def _arr(self, density=0.1, n=4096, seed=0, dtype=np.float32):
+        rng = np.random.default_rng(seed)
+        flat = np.zeros(n, dtype)
+        k = int(n * density)
+        idx = rng.choice(n, size=k, replace=False)
+        flat[idx] = rng.standard_normal(k).astype(dtype)
+        flat[idx[flat[idx] == 0]] = 1.0  # ensure chosen slots are nonzero
+        return flat
+
+    def test_pack_matches_oracle(self):
+        from nnstreamer_tpu.ops.sparse import pack, pack_reference
+        flat = self._arr(0.1)
+        ref_idx, ref_vals = pack_reference(flat)
+        idx, vals, nnz = pack(jnp.asarray(flat), 1024)
+        nnz = int(nnz)
+        assert nnz == len(ref_idx)
+        np.testing.assert_array_equal(np.asarray(idx)[:nnz], ref_idx)
+        np.testing.assert_array_equal(np.asarray(vals)[:nnz], ref_vals)
+
+    def test_pack_overflow_reports_true_nnz(self):
+        from nnstreamer_tpu.ops.sparse import pack
+        flat = self._arr(0.5, n=256)
+        _, _, nnz = pack(jnp.asarray(flat), 16)  # capacity << nnz
+        assert int(nnz) == int((flat != 0).sum())  # not clamped
+
+    def test_unpack_roundtrip(self):
+        from nnstreamer_tpu.ops.sparse import pack, unpack
+        flat = self._arr(0.07, n=2048, seed=2)
+        idx, vals, nnz = pack(jnp.asarray(flat), 256)
+        dense = np.asarray(unpack(idx, vals, 2048))
+        np.testing.assert_array_equal(dense, flat)
+
+    def test_unpack_empty(self):
+        from nnstreamer_tpu.ops.sparse import pack, unpack
+        flat = np.zeros(64, np.float32)
+        idx, vals, nnz = pack(jnp.asarray(flat), 8)
+        assert int(nnz) == 0
+        np.testing.assert_array_equal(np.asarray(unpack(idx, vals, 64)),
+                                      flat)
+
+
+class TestSparseElementsDevicePath:
+    def test_device_enc_wire_equals_host_wire(self):
+        """density<1 device pack produces byte-identical wire output to
+        the host encoder, and overflow falls back (never truncates)."""
+        import jax
+        from nnstreamer_tpu.elements.sparse import (TensorSparseEnc,
+                                                    sparse_encode)
+        from nnstreamer_tpu.tensors.buffer import Buffer, Chunk
+
+        flat = TestSparsePack()._arr(0.05, n=1024, seed=4).reshape(32, 32)
+        host_wire = sparse_encode(flat)
+        enc = TensorSparseEnc(density=0.25)
+        out = enc.transform(Buffer([Chunk(jax.device_put(flat))]))
+        np.testing.assert_array_equal(
+            out.chunks[0].host(), np.frombuffer(host_wire, np.uint8))
+        # overflow: a denser frame than promised falls back to host path
+        dense = np.ones((32, 32), np.float32)
+        out2 = enc.transform(Buffer([Chunk(jax.device_put(dense))]))
+        np.testing.assert_array_equal(
+            out2.chunks[0].host(),
+            np.frombuffer(sparse_encode(dense), np.uint8))
+
+    def test_device_dec_roundtrip(self):
+        import jax
+        from nnstreamer_tpu.elements.sparse import (TensorSparseDec,
+                                                    TensorSparseEnc)
+        from nnstreamer_tpu.tensors.buffer import Buffer, Chunk
+        from nnstreamer_tpu.tensors.caps import Caps
+
+        flat = TestSparsePack()._arr(0.1, n=512, seed=5).reshape(16, 32)
+        enc = TensorSparseEnc()
+        dec = TensorSparseDec(device=True)
+        dec.transform_caps(Caps(
+            "other/tensors,format=static,num_tensors=1,"
+            "types=(string)float32,dimensions=(string)32:16"))
+        wire = enc.transform(Buffer([Chunk(flat)]))
+        out = dec.transform(wire)
+        assert isinstance(out.chunks[0].raw, jax.Array)
+        np.testing.assert_array_equal(out.chunks[0].host(), flat)
+
+    def test_device_dec_varying_nnz_buckets(self):
+        """Per-frame nnz varies; the device path pads to pow2 buckets so
+        the jitted scatter compiles O(log size) shapes, and every frame
+        still decodes exactly."""
+        from nnstreamer_tpu.elements.sparse import (TensorSparseDec,
+                                                    TensorSparseEnc)
+        from nnstreamer_tpu.tensors.buffer import Buffer, Chunk
+        from nnstreamer_tpu.tensors.caps import Caps
+
+        enc = TensorSparseEnc()
+        dec = TensorSparseDec(device=True)
+        dec.transform_caps(Caps(
+            "other/tensors,format=static,num_tensors=1,"
+            "types=(string)float32,dimensions=(string)64"))
+        for seed, density in ((0, 0.02), (1, 0.3), (2, 0.9), (3, 0.0)):
+            flat = TestSparsePack()._arr(density, n=64, seed=seed)
+            out = dec.transform(enc.transform(Buffer([Chunk(flat)])))
+            np.testing.assert_array_equal(out.chunks[0].host(), flat)
