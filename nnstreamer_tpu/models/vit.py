@@ -1,0 +1,94 @@
+"""Vision Transformer — the dense-MXU vision model of the zoo.
+
+MobileNet's depthwise convolutions under-use the systolic array by
+construction (feature_group_count slices the MXU); a ViT is dense
+matmuls end to end, so it is the model where MFU on TPU approaches the
+hardware ceiling. Fills the classification slot the reference serves
+with heavyweight backbones via its vendor SDK subplugins (ref:
+ext/nnstreamer/tensor_filter/tensor_filter_tensorflow_lite.cc model
+zoo usage in tests); here it is a first-class zoo citizen:
+
+    zoo://vit?size=224&patch=16&d_model=768&layers=12&heads=12
+
+Same output contract as mobilenet_v2 (uint8 frame in, [classes] float32
+logits out) so image_labeling decodes it unchanged.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..tensors.info import TensorsInfo
+from .zoo import jit_init, register_model
+
+
+class EncoderBlock(nn.Module):
+    d_model: int
+    heads: int
+    mlp_ratio: int = 4
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.MultiHeadDotProductAttention(
+            num_heads=self.heads, dtype=self.dtype)(h, h)
+        x = x + h
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.Dense(self.d_model * self.mlp_ratio, dtype=self.dtype)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(self.d_model, dtype=self.dtype)(h)
+        return x + h
+
+
+class ViT(nn.Module):
+    patch: int = 16
+    d_model: int = 768
+    layers: int = 12
+    heads: int = 12
+    classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        # patch embedding: one conv with stride=kernel=patch (a dense
+        # [p*p*3, d] matmul per patch on the MXU)
+        x = nn.Conv(self.d_model, (self.patch, self.patch),
+                    strides=(self.patch, self.patch), padding="VALID",
+                    dtype=self.dtype)(x)
+        b, hp, wp, d = x.shape
+        x = x.reshape(b, hp * wp, d)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, hp * wp, d), jnp.float32)
+        x = x + pos.astype(self.dtype)
+        for _ in range(self.layers):
+            x = EncoderBlock(self.d_model, self.heads,
+                             dtype=self.dtype)(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        x = x.mean(axis=1)  # mean-pool (no cls token: shape-stable)
+        return nn.Dense(self.classes, dtype=jnp.float32)(
+            x.astype(jnp.float32))
+
+
+@register_model("vit")
+def _build_vit(size: str = "224", patch: str = "16", d_model: str = "768",
+               layers: str = "12", heads: str = "12",
+               classes: str = "1000", seed: str = "0"):
+    hw = int(size)
+    model = ViT(patch=int(patch), d_model=int(d_model), layers=int(layers),
+                heads=int(heads), classes=int(classes))
+    dummy = jnp.zeros((1, hw, hw, 3), jnp.bfloat16)
+    params = jit_init(model, seed, dummy)
+
+    def apply_fn(p, frame):
+        batched = frame.ndim == 4
+        x = frame.astype(jnp.bfloat16) / 127.5 - 1.0
+        out = model.apply(p, x if batched else x[None])
+        return out if batched else out[0]
+
+    in_info = TensorsInfo.make("uint8", f"3:{hw}:{hw}")
+    out_info = TensorsInfo.make("float32", classes)
+    return apply_fn, params, in_info, out_info

@@ -311,9 +311,14 @@ class TensorTestSrc(SrcElement):
     # device=true pre-stages a pool of frames in HBM and cycles them, so
     # the stream is device-resident from the source on (MLPerf-offline
     # style): downstream device elements see zero H2D cost, isolating
-    # the runtime's own per-buffer overhead from the host link
+    # the runtime's own per-buffer overhead from the host link.
+    # unique=true (the default) additionally adds the frame counter to
+    # each pooled frame ON DEVICE (one tiny fused op, no host bytes), so
+    # every emitted frame is distinct — a remote transport that caches
+    # repeat executions by (executable, args) cannot serve pool repeats
+    # from cache and fake downstream throughput.
     PROPS = {"caps": "", "pattern": "counter", "seed": 0, "is-live": False,
-             "device": False, "pool-size": 4}
+             "device": False, "pool-size": 4, "unique": True}
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
@@ -321,6 +326,7 @@ class TensorTestSrc(SrcElement):
         self._count = 0
         self._rng = None
         self._pool = None
+        self._uniq = None
 
     def negotiate_src_caps(self) -> Optional[Caps]:
         if not self.caps:
@@ -363,7 +369,13 @@ class TensorTestSrc(SrcElement):
                 self._pool = [
                     [Chunk(jax.device_put(a)) for a in self._make_frame(i)]
                     for i in range(n)]
+                if self.unique:
+                    self._uniq = jax.jit(lambda a, s: a + s)
             chunks = self._pool[self._count % len(self._pool)]
+            if self._uniq is not None:
+                chunks = [Chunk(self._uniq(
+                    c.raw, np.asarray(self._count % 199 + 1).astype(c.dtype)))
+                    for c in chunks]
         else:
             chunks = [Chunk(a) for a in self._make_frame(self._count)]
         cfg = self._config

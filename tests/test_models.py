@@ -179,3 +179,27 @@ def test_posenet_device_decode_feeds_pose_decoder():
     for b in frames:
         assert b.chunks[0].host().shape == (129, 129, 4)
         assert len(b.extras["keypoints"]) == 17
+
+
+def test_vit_forward_and_pipeline():
+    """zoo://vit: dense-MXU classifier, same in/out contract as
+    mobilenet_v2 (uint8 frame -> [classes] logits) so image_labeling
+    decodes it unchanged."""
+    import numpy as np
+    from nnstreamer_tpu.models import zoo
+
+    apply_fn, params, in_info, out_info = zoo.build(
+        "vit", size="64", patch="16", d_model="64", layers="2",
+        heads="4", classes="10")
+    assert tuple(in_info[0].shape) == (64, 64, 3)
+    assert tuple(out_info[0].shape) == (10,)
+    frame = np.random.default_rng(0).integers(
+        0, 255, (64, 64, 3), np.uint8, endpoint=True)
+    out = np.asarray(apply_fn(params, frame))
+    assert out.shape == (10,)
+    assert out.dtype == np.float32
+    # batched invoke broadcasts over the leading dim
+    batch = np.stack([frame, frame])
+    bout = np.asarray(apply_fn(params, batch))
+    assert bout.shape == (2, 10)
+    np.testing.assert_allclose(bout[0], out, atol=1e-4)
