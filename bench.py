@@ -196,7 +196,7 @@ def bench_pipeline_devres(batch: int = 32):
     n = 96
     fps, p50 = run_pipeline(
         f"tensortestsrc caps={caps(f'3:224:224:{batch}')} pattern=random "
-        f"device=true num-buffers={n + 8} ! queue max-size-buffers=4 "
+        f"device=true unique=true num-buffers={n + 8} ! queue max-size-buffers=4 "
         "! tensor_filter framework=jax model=zoo://mobilenet_v2 "
         "prefetch-host=true ! appsink name=out", warmup=8, frames=n, frames_per_buffer=batch)
     return fps, p50

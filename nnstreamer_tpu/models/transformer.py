@@ -42,8 +42,12 @@ class GPTConfig:
     # distributed knobs (None = single chip)
     mesh: Optional[jax.sharding.Mesh] = None
     data_axis: Optional[str] = "data"
-    seq_axis: Optional[str] = None     # set to e.g. "seq" for ring attention
+    seq_axis: Optional[str] = None     # set to e.g. "seq" for seq parallelism
     model_axis: Optional[str] = "model"
+    # sequence-parallel attention scheme: "ring" (K/V ppermute ring,
+    # any head count) or "ulysses" (all-to-all head/seq exchange, needs
+    # heads % seq_axis_size == 0; fewer collectives) — both exact
+    seq_scheme: str = "ring"
 
     @property
     def ff(self) -> int:
@@ -132,8 +136,18 @@ def _dense_attention(q, k, v, positions_q, positions_k):
 
 
 def _attention(q, k, v, positions, cfg: GPTConfig):
+    if cfg.seq_scheme not in ("ring", "ulysses"):
+        # both schemes are exact, so a typo would be undetectable from
+        # outputs — fail loudly instead of silently running ring
+        raise ValueError(f"unknown seq_scheme {cfg.seq_scheme!r}; "
+                         "expected 'ring' or 'ulysses'")
     if cfg.mesh is not None and cfg.seq_axis in cfg.mesh.axis_names \
             and cfg.mesh.shape[cfg.seq_axis] > 1:
+        if cfg.seq_scheme == "ulysses":
+            from ..parallel.ulysses import ulysses_attention_sharded
+            return ulysses_attention_sharded(
+                q, k, v, cfg.mesh, cfg.data_axis, cfg.seq_axis,
+                cfg.model_axis)
         from ..parallel.ring import ring_attention_sharded
         return ring_attention_sharded(q, k, v, cfg.mesh, cfg.data_axis,
                                       cfg.seq_axis, cfg.model_axis)

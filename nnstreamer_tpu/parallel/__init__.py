@@ -4,7 +4,8 @@ and the sharded training step.
 This package fills the reference's distributed slot (nnstreamer-edge TCP/
 MQTT-hybrid fan-out, SURVEY.md §2.4) the TPU way: intra-pod scale is a
 ``jax.sharding.Mesh`` with XLA collectives over ICI; sequence parallelism
-is first-class via ring attention (parallel/ring.py); cross-host streaming
+is first-class via ring attention (parallel/ring.py) and Ulysses-style
+all-to-all head/sequence exchange (parallel/ulysses.py); cross-host streaming
 stays in the query/edge elements (elements/query.py) over DCN sockets.
 """
 from .mesh import best_mesh, make_mesh

@@ -1,0 +1,90 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+The second of the two standard long-context schemes (the other is ring
+attention, parallel/ring.py): instead of rotating K/V blocks around the
+ICI ring, every device swaps its sequence shard for a HEAD shard with
+one ``all_to_all``, computes ordinary full-sequence attention over its
+head slice, and swaps back. Two collectives per layer, each moving
+activations once — communication volume is O(S·H·D/n) independent of
+the ring's n steps, at the cost of requiring heads % n == 0.
+
+When to use which (both are exact):
+  * ring    — heads < devices, or ultra-long S where even one gathered
+              head slice [B, S, H/n, D] exceeds memory budget.
+  * ulysses — plenty of heads, moderate S: fewer collectives, and the
+              attention itself is an unsharded matmul XLA can fuse
+              freely (no scan carry).
+
+The reference has no analog (long prompts live inside llama.cpp's own
+context, SURVEY.md §5); on TPU sequence parallelism is a framework
+feature.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .ring import dense_reference
+
+
+def ulysses_attention_local(q, k, v, axis_name: str):
+    """Per-shard body (call inside shard_map).
+
+    q/k/v: local sequence blocks [B, T, H, D] with S = n·T sharded over
+    ``axis_name``; requires H % n == 0. Returns the local [B, T, H, D]
+    output block.
+    """
+    n = jax.lax.psum(1, axis_name)
+    b, t, h, d = q.shape
+
+    def seq_to_heads(x):
+        # [B, T, H, D] -> exchange: keep H/n heads, gain full sequence.
+        # split the head-group axis across peers, concat received seq
+        # blocks in source-rank order (= global sequence order)
+        x = x.reshape(b, t, n, h // n * d)
+        x = jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                               tiled=True)
+        return x.reshape(b, n * t, h // n, d)
+
+    def heads_to_seq(x):
+        # inverse: [B, n*T, H/n, D] -> [B, T, H, D]. split the seq-block
+        # axis across peers, concat received head groups in source-rank
+        # order (= original head order)
+        x = x.reshape(b, n, t, h // n * d)
+        x = jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=3,
+                               tiled=True)
+        return x.reshape(b, t, h, d)
+
+    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = dense_reference(qg, kg, vg)  # full-seq causal attn, H/n heads
+    return heads_to_seq(out)
+
+
+def ulysses_attention_sharded(q, k, v, mesh: Mesh,
+                              data_axis: Optional[str], seq_axis: str,
+                              model_axis: Optional[str]):
+    """shard_map wrapper: q/k/v are global [B,S,H,D] arrays; B over
+    data, S over seq, heads over model (same signature as
+    ring_attention_sharded, so callers can switch schemes by name)."""
+    n = mesh.shape[seq_axis]
+    da = data_axis if data_axis in mesh.axis_names else None
+    ma = model_axis if model_axis in mesh.axis_names else None
+    # the guard must apply to the LOCAL head count: in_specs shard heads
+    # over the model axis too, so each shard sees heads/model_size
+    local_heads = q.shape[2] // (mesh.shape[ma] if ma else 1)
+    if local_heads == 0 or local_heads % n != 0:
+        raise ValueError(
+            f"ulysses: local head count {local_heads} (= {q.shape[2]} "
+            f"heads / model axis) not divisible by seq axis size {n}; "
+            "use ring attention for this shape")
+    spec = P(da, seq_axis, ma, None)
+
+    fn = jax.shard_map(
+        partial(ulysses_attention_local, axis_name=seq_axis),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)

@@ -312,13 +312,15 @@ class TensorTestSrc(SrcElement):
     # the stream is device-resident from the source on (MLPerf-offline
     # style): downstream device elements see zero H2D cost, isolating
     # the runtime's own per-buffer overhead from the host link.
-    # unique=true (the default) additionally adds the frame counter to
-    # each pooled frame ON DEVICE (one tiny fused op, no host bytes), so
-    # every emitted frame is distinct — a remote transport that caches
-    # repeat executions by (executable, args) cannot serve pool repeats
-    # from cache and fake downstream throughput.
+    # unique=true additionally adds the frame counter to each pooled
+    # frame ON DEVICE (one tiny fused op, no host bytes), so every
+    # emitted frame is distinct — a remote transport that caches repeat
+    # executions by (executable, args) cannot serve pool repeats from
+    # cache and fake downstream throughput. Off by default: it perturbs
+    # frame CONTENT, which belongs to benchmark configs, not to
+    # pipelines that verify pattern semantics.
     PROPS = {"caps": "", "pattern": "counter", "seed": 0, "is-live": False,
-             "device": False, "pool-size": 4, "unique": True}
+             "device": False, "pool-size": 4, "unique": False}
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
