@@ -77,8 +77,19 @@ def run(n_devices: int) -> float:
     state, loss = step(state, batch)
     loss.block_until_ready()
     assert jnp.isfinite(loss), f"non-finite loss {loss}"
+    schemes = "ring"
+    if sp > 1 and (cfg.n_heads // tp) % sp == 0:
+        # same step through the OTHER sequence-parallel scheme, so the
+        # driver validates both collective patterns compile + execute
+        import dataclasses
+        cfg_u = dataclasses.replace(cfg, seq_axis="seq",
+                                    seq_scheme="ulysses")
+        loss_u = tfm.loss_fn(state.params, batch, cfg_u)
+        loss_u.block_until_ready()
+        assert jnp.isfinite(loss_u), f"non-finite ulysses loss {loss_u}"
+        schemes = "ring+ulysses"
     print(f"dryrun_multichip: mesh dp={dp} sp={sp} tp={tp} "
-          f"loss={float(loss):.4f} ok", flush=True)
+          f"seq={schemes} loss={float(loss):.4f} ok", flush=True)
     return float(loss)
 
 
