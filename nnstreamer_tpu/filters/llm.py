@@ -8,7 +8,8 @@ static shapes, one jitted decode step reused every token.
 
 model accepts ``zoo://gpt?...`` (zoo spec) or a ``get_lm()`` python file
 returning (params, cfg). custom properties (``custom=key:value,...``):
-max_tokens, temperature (0 = greedy), seed, max_len, n_parallel.
+max_tokens, temperature (0 = greedy), top_k, top_p, seed, max_len,
+n_parallel, chunk.
 
 ``n_parallel:M`` (M>1) turns on continuous-batching decode: up to M
 concurrent prompts share ONE decode dispatch per token step (the
@@ -106,6 +107,7 @@ class LlmFilter(FilterFramework):
         # n_parallel mode (a new prompt waits for the current chunk).
         self._chunk = max(1, int(self._opts.get("chunk", "1")))
         self._chunk_jits: Dict[tuple, Any] = {}
+        self._sampling_cache = None  # re-parse on every open()
         with self._cond:
             # prompts queued before a close() belong to the previous
             # session (and carry its ctx buffers) — never replay them
@@ -167,15 +169,34 @@ class LlmFilter(FilterFramework):
         self.stats["prefill_dispatches"] += 1
         return logits, cache
 
+    def _sampling(self):
+        """(top_k, top_p) from custom properties (llamacpp sampler-chain
+        parity: same knobs, same order — nucleus before temperature).
+        Parsed once: this sits on the per-token host loop."""
+        cached = getattr(self, "_sampling_cache", None)
+        if cached is None:
+            cached = self._sampling_cache = (
+                int(self._opts.get("top_k", "0")),
+                float(self._opts.get("top_p", "1.0")))
+        return cached
+
+    def _sample_host(self, sub, logits, temperature):
+        """One host-loop sampling step, via the SAME in-graph helper the
+        scanned chunk body uses, so every path draws identical tokens."""
+        return self._tfm.sample_logits(sub[None], logits, temperature,
+                                       *self._sampling())[:1]
+
     def _chunk_fn(self, steps: int, temperature: float):
-        """Jitted K-step decode chunk, cached per (steps, temperature)."""
-        key = (steps, float(temperature))
+        """Jitted K-step decode chunk, cached per (steps, sampling)."""
+        top_k, top_p = self._sampling()
+        key = (steps, float(temperature), top_k, top_p)
         fn = self._chunk_jits.get(key)
         if fn is None:
             import jax
             tfm, cfg = self._tfm, self._cfg
             fn = jax.jit(lambda p, c, l, k, a: tfm.decode_chunk_multi(
-                p, c, l, k, a, cfg, steps=steps, temperature=temperature))
+                p, c, l, k, a, cfg, steps=steps, temperature=temperature,
+                top_k=top_k, top_p=top_p))
             self._chunk_jits[key] = fn
         return fn
 
@@ -206,7 +227,7 @@ class LlmFilter(FilterFramework):
                 return
             if temperature > 0:
                 key, sub = jax.random.split(key)
-                tok = jax.random.categorical(sub, logits / temperature, -1)
+                tok = self._sample_host(sub, logits, temperature)
             else:
                 tok = jnp.argmax(logits, -1)
             emit(np.asarray(tok, np.int32))
@@ -239,7 +260,7 @@ class LlmFilter(FilterFramework):
                 # sampled token before stopping — mirror it, no decode
                 if temperature > 0:
                     key2, sub = jax.random.split(keys[0])
-                    tok = jax.random.categorical(sub, logits / temperature, -1)
+                    tok = self._sample_host(sub, logits, temperature)
                 else:
                     tok = jnp.argmax(logits, -1)
                 emit(np.asarray(tok, np.int32))
@@ -362,9 +383,8 @@ class LlmFilter(FilterFramework):
                         continue
                     s["key"], sub = jax.random.split(s["key"])
                     subs.append(sub)
-                tok = jax.vmap(
-                    lambda k, l: jax.random.categorical(k, l / temperature))(
-                        jnp.stack(subs), logits)
+                tok = self._tfm.sample_logits(
+                    jnp.stack(subs), logits, temperature, *self._sampling())
             else:
                 tok = jnp.argmax(logits, -1)
             tok = tok.astype(jnp.int32)

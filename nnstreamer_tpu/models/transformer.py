@@ -332,8 +332,46 @@ def decode_step_multi(params, cache, token, active, cfg: GPTConfig):
     return logits, cache
 
 
+def sample_logits(keys, logits, temperature: float, top_k: int = 0,
+                  top_p: float = 1.0):
+    """Per-stream token sampling, jit-safe, shared by the host decode
+    loops and the scanned chunk body so every path draws identical
+    tokens for the same keys.
+
+    keys [B,2] uint32, logits [B,V] f32 -> [B] int32. temperature<=0 is
+    greedy argmax (keys ignored). top_k keeps the K best logits, top_p
+    the smallest prefix of the sorted distribution with cumulative
+    probability >= p (nucleus sampling) — the knobs llamacpp exposes on
+    the reference's generative slot (tensor_filter_llamacpp.cc sampler
+    chain), computed in-graph on device.
+    """
+    if temperature <= 0:
+        return jnp.argmax(logits, -1).astype(jnp.int32)
+    # llamacpp chain order: the top_k/top_p nucleus is formed on the
+    # UNSCALED distribution, temperature only shapes the final draw —
+    # so migrated configs keep their candidate sets
+    l0 = logits.astype(jnp.float32)
+    if top_k and top_k > 0:
+        kth = jax.lax.top_k(l0, min(top_k, l0.shape[-1]))[0][..., -1:]
+        l0 = jnp.where(l0 < kth, -jnp.inf, l0)
+    if top_p < 1.0:
+        srt = jnp.flip(jnp.sort(l0, axis=-1), axis=-1)
+        probs = jax.nn.softmax(srt, axis=-1)
+        exclusive = jnp.cumsum(probs, axis=-1) - probs
+        # exclusive <= 0 always keeps the best token: top_p<=0 must
+        # degrade to greedy, not to an all-masked row (categorical over
+        # all -inf silently returns index 0)
+        kept = jnp.where((exclusive < top_p) | (exclusive <= 0.0),
+                         srt, jnp.inf)
+        thr = jnp.min(kept, axis=-1, keepdims=True)  # smallest kept logit
+        l0 = jnp.where(l0 < thr, -jnp.inf, l0)
+    return jax.vmap(lambda k, row: jax.random.categorical(k, row))(
+        keys, l0 / temperature).astype(jnp.int32)
+
+
 def decode_chunk_multi(params, cache, logits, keys, active, cfg: GPTConfig,
-                       *, steps: int, temperature: float = 0.0):
+                       *, steps: int, temperature: float = 0.0,
+                       top_k: int = 0, top_p: float = 1.0):
     """``steps`` sample+decode rounds for B streams in ONE dispatch.
 
     A ``lax.scan`` over :func:`decode_step_multi` with the sampling
@@ -358,12 +396,10 @@ def decode_chunk_multi(params, cache, logits, keys, active, cfg: GPTConfig,
         if temperature > 0:
             pair = jax.vmap(jax.random.split)(ks)      # [B,2,2]
             ks2, subs = pair[:, 0], pair[:, 1]
-            tok = jax.vmap(lambda k, l: jax.random.categorical(
-                k, l / temperature))(subs, lg)
+            tok = sample_logits(subs, lg, temperature, top_k, top_p)
         else:
             ks2 = ks
-            tok = jnp.argmax(lg, -1)
-        tok = tok.astype(jnp.int32)
+            tok = sample_logits(ks, lg, 0.0)
         lg2, ca2 = decode_step_multi(params, ca, tok, active, cfg)
         return (lg2, ca2, ks2), tok
 

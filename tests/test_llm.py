@@ -426,3 +426,90 @@ def test_chunked_batched_sampling_reproducible():
     ref, _ = _gen_tokens(
         "max_tokens:6,max_len:32,temperature:0.7,seed:5", p2)
     np.testing.assert_array_equal(got["b"], ref)
+
+
+# -- sampling controls (custom=top_k / top_p) -------------------------------
+
+def test_top_k_1_equals_greedy():
+    p = np.array([2, 9, 4], np.int32)
+    greedy, _ = _gen_tokens("max_tokens:10,max_len:32", p)
+    topk1, _ = _gen_tokens(
+        "max_tokens:10,max_len:32,temperature:0.9,seed:7,top_k:1", p)
+    np.testing.assert_array_equal(topk1, greedy)
+
+
+def test_tiny_top_p_equals_greedy():
+    p = np.array([5, 5, 5], np.int32)
+    greedy, _ = _gen_tokens("max_tokens:8,max_len:32", p)
+    nucleus, _ = _gen_tokens(
+        "max_tokens:8,max_len:32,temperature:1.3,seed:2,top_p:0.0001", p)
+    np.testing.assert_array_equal(nucleus, greedy)
+
+
+def test_chunked_sampling_with_topk_topp_matches_per_token():
+    """top_k/top_p ride the shared sample_logits helper: the chunked
+    scan emits the same tokens as the per-token host loop."""
+    p = np.array([7, 1], np.int32)
+    ref, _ = _gen_tokens(
+        "max_tokens:10,max_len:32,temperature:0.8,seed:3,top_k:8,top_p:0.9",
+        p)
+    got, _ = _gen_tokens(
+        "max_tokens:10,max_len:32,temperature:0.8,seed:3,top_k:8,"
+        "top_p:0.9,chunk:4", p)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_sample_logits_respects_top_k():
+    """Every draw lands inside the top-k set (in-graph masking)."""
+    import jax
+    import jax.numpy as jnp
+    from nnstreamer_tpu.models.transformer import sample_logits
+
+    logits = jnp.asarray(
+        np.random.default_rng(0).standard_normal((4, 64)), jnp.float32)
+    top4 = np.argsort(np.asarray(logits), axis=-1)[:, -4:]
+    for seed in range(5):
+        keys = jax.vmap(jax.random.PRNGKey)(
+            jnp.arange(4) + seed * 10)
+        toks = np.asarray(sample_logits(keys, logits, 1.5, top_k=4))
+        for row in range(4):
+            assert toks[row] in top4[row], (row, toks[row])
+
+
+def test_sample_logits_respects_top_p():
+    """With a spiked distribution, tiny top_p must always pick the
+    spike; with top_p=1.0 sampling stays unrestricted."""
+    import jax
+    import jax.numpy as jnp
+    from nnstreamer_tpu.models.transformer import sample_logits
+
+    logits = jnp.zeros((2, 32), jnp.float32).at[:, 5].set(8.0)
+    keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(2))
+    toks = np.asarray(sample_logits(keys, logits, 2.0, top_p=0.5))
+    np.testing.assert_array_equal(toks, [5, 5])
+
+
+def test_top_p_zero_degrades_to_greedy():
+    """top_p<=0 must keep the best token (greedy), never an all-masked
+    row silently emitting token 0."""
+    p = np.array([4, 2], np.int32)
+    greedy, _ = _gen_tokens("max_tokens:8,max_len:32", p)
+    z, _ = _gen_tokens(
+        "max_tokens:8,max_len:32,temperature:1.0,seed:1,top_p:0", p)
+    np.testing.assert_array_equal(z, greedy)
+
+
+def test_nucleus_formed_before_temperature():
+    """llamacpp chain order: the top_p candidate set comes from the
+    UNSCALED distribution, so cranking temperature cannot widen it."""
+    import jax
+    import jax.numpy as jnp
+    from nnstreamer_tpu.models.transformer import sample_logits
+
+    # two dominant tokens (~50/50), the rest tiny: nucleus at 0.9 keeps
+    # exactly {3, 11} regardless of temperature
+    logits = jnp.full((1, 32), -10.0).at[0, 3].set(5.0).at[0, 11].set(5.0)
+    for seed in range(12):
+        keys = jax.random.PRNGKey(seed)[None]
+        tok = int(sample_logits(keys, logits, 50.0, top_p=0.9)[0])
+        assert tok in (3, 11), tok
