@@ -81,6 +81,14 @@ class LlmFilter(FilterFramework):
             with open(model) as f:
                 exec(compile(f.read(), model, "exec"), ns)  # noqa: S102 — user script
             self._params, self._cfg = ns["get_lm"]()
+        elif model.endswith(".gguf"):
+            # the extension routes here for reference auto-detect parity,
+            # but gguf weight unpacking is out of scope — fail with a
+            # pointer instead of a generic loader error
+            raise NotImplementedError(
+                "llm: .gguf weight loading is not implemented; export "
+                "the weights to a get_lm() python module instead (see "
+                "Documentation/tutorials/generative-pipelines.md)")
         else:
             raise ValueError(f"llm filter cannot load model {model!r}")
         self._opts = _parse_custom(props.custom_properties)
