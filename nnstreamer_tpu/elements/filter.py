@@ -68,6 +68,12 @@ class TensorFilter(Element):
         # latency per frame. Off by default: chained device-resident
         # elements should NOT force transfers.
         "prefetch-host": False,
+        # run one zero-filled invoke at caps negotiation so the XLA
+        # compile (tens of seconds for a big model) happens before the
+        # first real frame instead of stalling it (no reference analog:
+        # its backends don't JIT; on TPU cold-start hygiene is a
+        # framework concern)
+        "warmup": False,
     }
 
     def __init__(self, name=None, **props):
@@ -221,6 +227,35 @@ class TensorFilter(Element):
             out_cfg = TensorsConfig(out_info, TensorFormat.STATIC,
                                     cfg.rate_n, cfg.rate_d)
         self.set_src_caps(Caps.from_config(out_cfg))
+        if self.warmup and not self.invoke_async and not self.invoke_dynamic \
+                and cfg.format == TensorFormat.STATIC:
+            # the same selection real frames will use (sel was computed
+            # above for STATIC caps); flexible streams have no fixed
+            # signature to warm
+            sel = cfg.info
+            if self._in_combi:
+                sel = TensorsInfo(cfg.info[i] for i in self._in_combi)
+            if len(sel):
+                self._warmup_invoke(sel)
+
+    def _warmup_invoke(self, sel: TensorsInfo) -> None:
+        """One zero-filled invoke with the NEGOTIATED stream shapes
+        (incl. any batch dim), so the jit cache is hot for the exact
+        signature real frames will hit. Failures are non-fatal: real
+        frames will surface the same error through the normal path."""
+        try:
+            zeros = [np.zeros(tuple(i.shape), i.type.np_dtype)
+                     for i in sel]
+            self.fw.invoke(zeros)
+            if self._watchdog is not None:
+                # a long warmup compile must not be answered by an
+                # immediate idle-suspend that clears the cache it built
+                self._watchdog.feed()
+            logger.info("%s: warmup invoke compiled %d input(s)",
+                        self.name, len(zeros))
+        except Exception as exc:  # noqa: BLE001
+            logger.warning("%s: warmup invoke failed (ignored): %s",
+                           self.name, exc)
 
     # -- hot path ---------------------------------------------------------
     def do_chain(self, pad: Pad, buf: Buffer) -> None:

@@ -192,3 +192,43 @@ class TestDetect:
     def test_known_backends(self):
         names = all_filters()
         assert {"jax", "custom-easy", "python3"} <= set(names)
+
+
+def test_warmup_compiles_before_first_frame():
+    """warmup=true: the negotiated signature is invoked once with zeros
+    at caps time, so the first streamed frame reuses the jit cache."""
+    import threading
+
+    from nnstreamer_tpu.pipeline.parser import parse_launch
+
+    capsq = ('"other/tensors,format=static,num_tensors=1,'
+             'types=(string)float32,dimensions=(string)64,'
+             'framerate=(fraction)0/1"')
+    pipe = parse_launch(
+        f"appsrc name=in caps={capsq} "
+        "! tensor_filter name=f framework=jax model=zoo://mlp warmup=true "
+        "! appsink name=out")
+    got = []
+    done = threading.Event()
+    pipe["out"].connect(lambda b: (got.append(b), done.set()))
+    pipe.start()
+    f = pipe["f"]
+    # caps + warmup flow on the appsrc loop thread: poll for the cache
+    # instead of racing it
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if f.fw is not None and len(f.fw._jit_cache) == 1:
+            break
+        time.sleep(0.02)
+    assert len(f.fw._jit_cache) == 1
+    import numpy as np
+    from nnstreamer_tpu import Buffer
+    pipe["in"].push_buffer(Buffer.from_arrays(
+        [np.zeros(64, np.float32)]))
+    assert done.wait(30)
+    n_compiled = len(f.fw._jit_cache)
+    pipe["in"].end_stream()
+    pipe.stop()
+    assert len(got) == 1
+    # same signature -> no second compile
+    assert n_compiled == 1
