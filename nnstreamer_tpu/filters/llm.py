@@ -76,6 +76,13 @@ class LlmFilter(FilterFramework):
                 n_layers=int(kwargs.get("n_layers", "6")))
             self._params = tfm.init_params(
                 self._cfg, jax.random.PRNGKey(int(kwargs.get("seed", "0"))))
+            if "params_dir" in kwargs:
+                # trained weights from an orbax checkpoint (e.g. saved by
+                # tensor_trainer / trainers/checkpoint.py) — the random
+                # init above provides the restore template
+                from ..trainers.checkpoint import restore_params
+                self._params = restore_params(kwargs["params_dir"],
+                                              self._params)
         elif model.endswith(".py"):
             ns: Dict[str, Any] = {}
             with open(model) as f:

@@ -513,3 +513,34 @@ def test_nucleus_formed_before_temperature():
         keys = jax.random.PRNGKey(seed)[None]
         tok = int(sample_logits(keys, logits, 50.0, top_p=0.9)[0])
         assert tok in (3, 11), tok
+
+
+def test_llm_loads_trained_weights_from_checkpoint(tmp_path):
+    """zoo://gpt?params_dir=... restores orbax weights (the
+    tensor_trainer save format): generation differs from random init
+    and is reproducible across opens."""
+    import jax
+
+    from nnstreamer_tpu.models import transformer as tfm
+    from nnstreamer_tpu.trainers.checkpoint import save_params
+
+    cfg = tfm.GPTConfig(vocab=64, d_model=32, n_heads=4, n_layers=2)
+    trained = tfm.init_params(cfg, jax.random.PRNGKey(42))  # "trained"
+    ckpt = str(tmp_path / "gpt-ckpt")
+    save_params(ckpt, trained)
+
+    base = ZOO  # seed 0 random init
+    with_ckpt = f"{ZOO}&params_dir={ckpt}"
+    p = np.array([7, 3, 1], np.int32)
+    out_random, _ = _gen_tokens("max_tokens:8,max_len:32", p)
+    fw_tokens = []
+    for _ in range(2):
+        from nnstreamer_tpu.filters.base import FilterProperties
+        from nnstreamer_tpu.filters.registry import find_filter
+        fw = find_filter("llm")()
+        fw.open(FilterProperties(model_files=(with_ckpt,),
+                                 custom_properties="max_tokens:8,max_len:32"))
+        fw_tokens.append(fw.invoke([p])[0])
+        fw.close()
+    np.testing.assert_array_equal(fw_tokens[0], fw_tokens[1])
+    assert not np.array_equal(fw_tokens[0], out_random)
