@@ -65,7 +65,14 @@ class PoseEstimation(DecoderPlugin):
             flat = hm.reshape(-1, k)
             idx = np.argmax(flat, axis=0)
             ys, xs = np.unravel_index(idx, (hp, wp))
-            scores = 1.0 / (1.0 + np.exp(-flat[idx, np.arange(k)]))
+            # the heatmap value is used AS the score, matching the
+            # reference's plain-heatmap mode (tensordec-pose.c:782 only
+            # sigmoids in HEATMAP_OFFSET mode; its doc header calls
+            # Tensor[0] "label sigmoid probability"). zoo://posenet
+            # already emits sigmoided maps, so this keeps the heatmap
+            # and decode=device paths on ONE score scale — the model's
+            # output scale, which is what score_threshold is defined on.
+            scores = flat[idx, np.arange(k)]
             return [(x / max(wp - 1, 1), y / max(hp - 1, 1), float(s))
                     for x, y, s in zip(xs, ys, scores)]
         pts = arr.reshape(-1, arr.shape[-1])  # [K, 2|3] normalized

@@ -72,7 +72,11 @@ class TensorFilter(Element):
         # compile (tens of seconds for a big model) happens before the
         # first real frame instead of stalling it (no reference analog:
         # its backends don't JIT; on TPU cold-start hygiene is a
-        # framework concern)
+        # framework concern). Only effective for sync invokes on STATIC
+        # caps: async/dynamic/flexible streams have no fixed invoke
+        # signature to warm (async backends such as the LLM filter warm
+        # through their own prefill path) — requesting it there logs a
+        # notice and does nothing.
         "warmup": False,
     }
 
@@ -227,16 +231,25 @@ class TensorFilter(Element):
             out_cfg = TensorsConfig(out_info, TensorFormat.STATIC,
                                     cfg.rate_n, cfg.rate_d)
         self.set_src_caps(Caps.from_config(out_cfg))
-        if self.warmup and not self.invoke_async and not self.invoke_dynamic \
-                and cfg.format == TensorFormat.STATIC:
-            # the same selection real frames will use (sel was computed
-            # above for STATIC caps); flexible streams have no fixed
-            # signature to warm
-            sel = cfg.info
-            if self._in_combi:
-                sel = TensorsInfo(cfg.info[i] for i in self._in_combi)
-            if len(sel):
-                self._warmup_invoke(sel)
+        if self.warmup:
+            if self.invoke_async or self.invoke_dynamic \
+                    or cfg.format != TensorFormat.STATIC:
+                # not silently inert: tell the user WHY nothing warmed
+                logger.info(
+                    "%s: warmup requested but skipped (%s) — no fixed "
+                    "invoke signature to warm; async filters warm via "
+                    "their own prefill path", self.name,
+                    "invoke-async" if self.invoke_async else
+                    "invoke-dynamic" if self.invoke_dynamic else
+                    "non-static stream format")
+            else:
+                # the same selection real frames will use (sel was
+                # computed above for STATIC caps)
+                sel = cfg.info
+                if self._in_combi:
+                    sel = TensorsInfo(cfg.info[i] for i in self._in_combi)
+                if len(sel):
+                    self._warmup_invoke(sel)
 
     def _warmup_invoke(self, sel: TensorsInfo) -> None:
         """One zero-filled invoke with the NEGOTIATED stream shapes

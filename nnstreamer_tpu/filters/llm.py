@@ -416,7 +416,12 @@ class LlmFilter(FilterFramework):
                 # loop's emit-then-check ordering exactly
                 if s["remaining"] <= 0 or s["pos"] > max_len:
                     streams[slot] = None
-            if any(s is not None for s in streams):
+                    # keep the mask current: a lane that just finished
+                    # must not keep writing/advancing its cache in the
+                    # trailing decode (decode_step_multi also position-
+                    # guards at max_len)
+                    active_np[slot] = False
+            if active_np.any():
                 logits, cache = self._decode_multi(
                     self._params, cache, tok, jnp.asarray(active_np))
                 self.stats["decode_dispatches"] += 1
@@ -433,7 +438,13 @@ class LlmFilter(FilterFramework):
         import jax
         import jax.numpy as jnp
 
-        # emits each stream still owes; K serves the deepest one fully
+        # emits each stream still owes; K serves the deepest one fully.
+        # The +1 is the capacity tail: the final token a lane emits at
+        # pos == max_len is sampled in-scan from the last legal decode's
+        # logits — the decode that FOLLOWS that sample is position-
+        # guarded inside decode_step_multi (pos < max_len), so it cannot
+        # clamp a write onto row max_len-1 (the single-stream invariant
+        # of _generate_chunked, enforced in-graph here).
         emits_left = [min(s["remaining"], max_len - s["pos"] + 1)
                       if s else 0 for s in streams]
         k = min(self._chunk, max(emits_left))

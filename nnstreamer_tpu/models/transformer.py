@@ -293,16 +293,29 @@ def decode_step_multi(params, cache, token, active, cfg: GPTConfig):
     n_batch, tensor_filter_llamacpp.cc:267). token [B] int32,
     active [B] bool; cache index is per-slot [B]. Inactive slots do not
     advance their index; their lanes compute garbage that the scheduler
-    never emits."""
+    never emits. Lanes whose position has reached max_len likewise
+    neither write nor advance: dynamic_update_slice would clamp such a
+    write onto row max_len-1, corrupting the last real cache row — the
+    in-graph form of the single-stream loop's "never decode past
+    capacity" guard (the emitted token stream is unchanged: logits a
+    full lane produces past capacity are never sampled)."""
     b = token.shape[0]
     pos = cache["index"]                       # [B]
     positions = pos[:, None]                   # [B,1]
     h = jnp.take(params["embed"], token[:, None], axis=0)
     max_len = cache["k"].shape[2]
     valid = jnp.arange(max_len)[None, :] <= pos[:, None]   # [B,L]
-    # per-slot cache write: each row lands at its own position
+    ok = active & (pos < max_len)              # may write + advance
+    lane = ok[:, None, None, None]             # [B,1,1,1] over [B,1,nh,hd]
+    # per-slot cache write: each row lands at its own position. Guarded
+    # lanes write their OLD row back (a no-op) instead of their new k/v:
+    # masking the one-row update is free, where a whole-cache select
+    # per layer would double the decode step's HBM traffic
     upd = jax.vmap(
         lambda c, x, p: jax.lax.dynamic_update_slice(c, x, (p, 0, 0)))
+    row = jax.vmap(
+        lambda c, p: jax.lax.dynamic_slice(
+            c, (p, 0, 0), (1, c.shape[1], c.shape[2])))
     new_k, new_v = [], []
     for i, layer in enumerate(params["layers"]):
         hd, nh = cfg.head_dim, cfg.n_heads
@@ -312,8 +325,12 @@ def decode_step_multi(params, cache, token, active, cfg: GPTConfig):
         k1 = rope((x @ layer["wk"]).reshape(b, 1, nh, hd), positions,
                   cfg.rope_theta)
         v1 = (x @ layer["wv"]).reshape(b, 1, nh, hd)
-        k = upd(cache["k"][i], k1.astype(cache["k"].dtype), pos)
-        v = upd(cache["v"][i], v1.astype(cache["v"].dtype), pos)
+        k = upd(cache["k"][i],
+                jnp.where(lane, k1.astype(cache["k"].dtype),
+                          row(cache["k"][i], pos)), pos)
+        v = upd(cache["v"][i],
+                jnp.where(lane, v1.astype(cache["v"].dtype),
+                          row(cache["v"][i], pos)), pos)
         new_k.append(k)
         new_v.append(v)
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
@@ -328,7 +345,7 @@ def decode_step_multi(params, cache, token, active, cfg: GPTConfig):
     h = rmsnorm(h, params["ln_f"])
     logits = (h[:, 0] @ params["head"]).astype(jnp.float32)
     cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v),
-             "index": pos + active.astype(jnp.int32)}
+             "index": pos + ok.astype(jnp.int32)}
     return logits, cache
 
 

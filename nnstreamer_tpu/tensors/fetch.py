@@ -74,6 +74,25 @@ class _Coalescer:
         self._q: List[_Ticket] = []
         self._cv = threading.Condition()
         self._thread: Optional[threading.Thread] = None
+        # achieved-depth accounting: frames (tickets) per device_get RPC
+        # is THE number that says whether the service actually amortizes
+        # the link round trip (1.0 = degenerated to frame-at-a-time)
+        self._stats = {"rpcs": 0, "frames": 0, "arrays": 0}
+
+    def stats(self, reset: bool = False) -> dict:
+        with self._cv:
+            out = dict(self._stats)
+            if reset:
+                self._stats.update(rpcs=0, frames=0, arrays=0)
+        out["frames_per_rpc_avg"] = (
+            out["frames"] / out["rpcs"] if out["rpcs"] else 0.0)
+        return out
+
+    def _account(self, n_tickets: int, n_arrays: int) -> None:
+        with self._cv:
+            self._stats["rpcs"] += 1
+            self._stats["frames"] += n_tickets
+            self._stats["arrays"] += n_arrays
 
     def _ensure_thread(self) -> None:
         if self._thread is None or not self._thread.is_alive():
@@ -102,14 +121,24 @@ class _Coalescer:
             flat = [a for t in grab for a in (t.arrays or ())]
             try:
                 host = jax.device_get(flat)
+                self._account(len(grab), len(flat))
             except BaseException:  # noqa: BLE001 - isolate per frame below
                 # one poisoned array (donated buffer, transient RPC error)
                 # must not fail every frame sharing the RPC: retry each
-                # ticket alone so only the genuinely bad frame errors out
+                # ticket alone so only the genuinely bad frame errors out.
+                # The failed round trip still cost a full RTT: count it
+                # (0 frames delivered) so frames_per_rpc_avg cannot read
+                # BETTER than reality on an unhealthy link; account each
+                # retry before delivering so a resolve-then-reset caller
+                # never sees counts land after its reset.
+                self._account(0, 0)
                 for t in grab:
                     try:
-                        t._deliver(jax.device_get(t.arrays or []))
+                        host1 = jax.device_get(t.arrays or [])
+                        self._account(1, len(t.arrays or ()))
+                        t._deliver(host1)
                     except BaseException as exc:  # noqa: BLE001
+                        self._account(0, 0)
                         t._deliver(None, exc)
                 continue
             i = 0
@@ -176,3 +205,10 @@ def submit_fetch(outputs: Sequence[Any]) -> List[Any]:
 def resolve(x: Any) -> Any:
     """Materialize ``x`` if it is a pending fetch; identity otherwise."""
     return x.resolve() if isinstance(x, PendingHost) else x
+
+
+def fetch_stats(reset: bool = False) -> dict:
+    """Coalescer counters: rpcs / frames / arrays since start (or last
+    reset) plus ``frames_per_rpc_avg``, the achieved batching depth —
+    the observability hook for "is the RTT actually being amortized"."""
+    return _coalescer.stats(reset=reset)

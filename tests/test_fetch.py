@@ -67,6 +67,34 @@ class TestSubmitFetch:
             np.testing.assert_allclose(F.resolve(outs[0]),
                                        np.full((4,), float(i)))
 
+    def test_fetch_stats_report_achieved_depth(self, monkeypatch):
+        """With a slow link (device_get stalled), frames queued behind
+        the in-flight RPC must share the NEXT one — frames_per_rpc_avg
+        > 1 — and the counters must add up. This is the bench's
+        fetch_coalesce proof hook (VERDICT r4 item 2)."""
+        real_get = jax.device_get
+        gate = threading.Event()
+
+        def slow_get(tree):
+            gate.wait(5.0)  # hold the first RPC until all frames queue
+            return real_get(tree)
+
+        monkeypatch.setattr(jax, "device_get", slow_get)
+        F.fetch_stats(reset=True)
+        jf = jax.jit(lambda s: jnp.full((4,), s))
+        pending = [F.submit_fetch([jf(float(i))]) for i in range(16)]
+        gate.set()
+        for i, outs in enumerate(pending):
+            np.testing.assert_allclose(F.resolve(outs[0]),
+                                       np.full((4,), float(i)))
+        stats = F.fetch_stats()
+        assert stats["frames"] == 16
+        assert stats["arrays"] == 16
+        # first RPC may carry 1 frame; everything else queued behind it
+        # must coalesce: strictly fewer RPCs than frames
+        assert stats["rpcs"] < 16
+        assert stats["frames_per_rpc_avg"] > 1.0
+
 
 class TestChunkIntegration:
     def test_chunk_resolves_transparently(self, dev_arrays):

@@ -428,6 +428,43 @@ def test_chunked_batched_sampling_reproducible():
     np.testing.assert_array_equal(got["b"], ref)
 
 
+@pytest.mark.parametrize("extra", ["", ",temperature:0.7,seed:5"])
+def test_chunked_batched_max_len_cutoff_matches_single(extra):
+    """Capacity cutoff in n_parallel+chunk mode: a stream that fills its
+    cache emits the single-stream token count/values (final token emitted
+    WITHOUT a decode — no clamped cache write at index max_len), while a
+    deeper co-resident stream keeps decoding past that point."""
+    from nnstreamer_tpu.filters.base import FilterProperties
+    from nnstreamer_tpu.filters.registry import find_filter
+    p1 = np.array([2, 5, 6], np.int32)        # fills max_len 8 first
+    p2 = np.array([1], np.int32)              # keeps going afterwards
+    ref1, _ = _gen_tokens("max_tokens:16,max_len:8" + extra, p1)
+    ref2, _ = _gen_tokens("max_tokens:16,max_len:8" + extra, p2)
+    assert len(ref1) == 6 and len(ref2) == 8  # capacity vs deeper stream
+    fw = find_filter("llm")()
+    fw.open(FilterProperties(
+        model_files=(ZOO,), invoke_async=True,
+        custom_properties=("max_tokens:16,max_len:8,n_parallel:2,chunk:4"
+                           + extra)))
+    got, done = {}, set()
+
+    def dispatch(outputs, ctx=None):
+        got.setdefault(ctx, []).append(int(outputs[0][0]))
+        if len(got[ctx]) == (6 if ctx == "a" else 8):
+            done.add(ctx)
+
+    fw.set_async_dispatcher(dispatch)
+    fw.invoke_async([p1], ctx="a")
+    fw.invoke_async([p2], ctx="b")
+    deadline = time.monotonic() + 120
+    while len(done) < 2 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    time.sleep(0.2)  # catch any EXTRA tokens beyond the references
+    fw.close()
+    np.testing.assert_array_equal(got["a"], ref1)
+    np.testing.assert_array_equal(got["b"], ref2)
+
+
 # -- sampling controls (custom=top_k / top_p) -------------------------------
 
 def test_top_k_1_equals_greedy():

@@ -122,12 +122,14 @@ def test_zoo_ssd_packed_matches_quad():
 
 
 def test_posenet_device_decode_matches_heatmap_positions():
-    """zoo://posenet?decode=device emits [K,3] keypoints whose argmax
-    positions equal the pose decoder's host heatmap decode (scores use
-    the model's already-sigmoided heatmap value, so only positions are
-    compared bit-exactly)."""
+    """zoo://posenet?decode=device emits [K,3] keypoints that match the
+    pose decoder's host heatmap decode — positions AND scores (both
+    paths report the model's already-sigmoided heatmap value, so one
+    score_threshold means the same thing on either path)."""
     import numpy as np
+    from nnstreamer_tpu.decoders.registry import find_decoder
     from nnstreamer_tpu.models import zoo
+    from nnstreamer_tpu.tensors.buffer import Buffer, Chunk
 
     apply_hm, params, _, _ = zoo.build("posenet", size="129")
     apply_kp, params2, _, out_info = zoo.build(
@@ -146,6 +148,11 @@ def test_posenet_device_decode_matches_heatmap_positions():
     np.testing.assert_allclose(kps[:, 1], ys, atol=1e-6)
     np.testing.assert_allclose(kps[:, 2], flat[idx, np.arange(k)],
                                rtol=1e-5)
+    # host heatmap decode must land on the SAME score scale
+    dec = find_decoder("pose_estimation")()
+    dec.set_options(["129:129", "129:129", "", "", "", "", "", "", ""])
+    host_kps = np.array(dec._keypoints(Buffer([Chunk(hm)])))
+    np.testing.assert_allclose(host_kps[:, 2], kps[:, 2], rtol=1e-5)
 
 
 def test_posenet_device_decode_feeds_pose_decoder():
