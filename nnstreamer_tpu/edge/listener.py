@@ -50,6 +50,18 @@ class TcpListener:
         self.stop_evt.set()
         if self._sock is not None:
             try:
+                # shutdown BEFORE close: closing an fd does NOT wake a
+                # thread blocked in accept() on Linux — the thread would
+                # zombie on the stale fd number, and when the kernel
+                # recycles that fd for a new CLIENT socket the old
+                # accept loop starts stealing from it (observed as
+                # phantom half-open connections after a broker restart).
+                # shutdown(SHUT_RDWR) wakes the blocked accept with an
+                # error so the loop exits before the fd is reused.
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
                 self._sock.close()
             except OSError:
                 pass

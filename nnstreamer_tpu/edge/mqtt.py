@@ -2,10 +2,10 @@
 
 ≙ the external MQTT broker (mosquitto) the reference's gst/mqtt elements
 talk to (mqttsink.c:29). Speaks the real MQTT 3.1.1 packet layer
-(edge/mqtt_wire.py) — CONNECT/CONNACK, SUBSCRIBE/SUBACK, PUBLISH qos0
-fan-out, PINGREQ/PINGRESP — so standard clients (Paho, mosquitto_pub/
-sub) interop with it, and the mqttsrc/mqttsink elements can equally be
-pointed at a real mosquitto instead.
+(edge/mqtt_wire.py) — CONNECT/CONNACK, SUBSCRIBE/SUBACK, PUBLISH qos0/
+qos1 fan-out with PUBACK, PINGREQ/PINGRESP — so standard clients (Paho,
+mosquitto_pub/sub) interop with it, and the mqttsrc/mqttsink elements
+can equally be pointed at a real mosquitto instead.
 
 Unlike the query DiscoveryBroker (control plane only), this broker is a
 data plane: the tensor bytes flow through it, exactly like raw
@@ -24,15 +24,25 @@ from .listener import TcpListener
 
 
 class MqttBroker:
-    """Minimal MQTT 3.1.1 topic fan-out broker (qos0)."""
+    """Minimal MQTT 3.1.1 topic fan-out broker (qos0 + qos1).
+
+    qos1 semantics (clean-session, like mosquitto with persistence off):
+    inbound qos1 PUBLISHes are PUBACKed; fan-out rides each
+    subscription's granted qos (min(published, subscribed)), with a
+    per-subscriber packet id and the subscriber's PUBACKs consumed."""
 
     def __init__(self, host: str = "localhost", port: int = 0):
         self._listener = TcpListener(host, port, self._conn_loop,
                                      name="mqtt-broker", backlog=64)
         self._lock = threading.Lock()
-        # subscriber conn -> (subscription filters, per-conn send lock)
+        # subscriber conn -> ([(filter, granted qos)], send lock, state)
         self._subs: Dict[socket.socket,
-                         Tuple[List[str], threading.Lock]] = {}
+                         Tuple[List[Tuple[str, int]], threading.Lock,
+                               Dict[str, int]]] = {}
+        # EVERY live conn (publishers too): stop() must close them all,
+        # or publisher threads zombie in read_packet holding half-open
+        # sockets that confuse reconnecting clients
+        self._conns: set = set()
 
     @property
     def bound_port(self) -> int:
@@ -45,7 +55,8 @@ class MqttBroker:
     def stop(self) -> None:
         self._listener.stop()
         with self._lock:
-            conns = list(self._subs)
+            conns = list(self._conns)
+            self._conns.clear()
             self._subs.clear()
         for c in conns:
             try:
@@ -54,6 +65,9 @@ class MqttBroker:
                 pass
 
     def _conn_loop(self, conn: socket.socket) -> None:
+        send_lock = threading.Lock()  # also guards publisher PUBACKs
+        with self._lock:
+            self._conns.add(conn)
         try:
             ptype, _, _ = mw.read_packet(conn)
             if ptype != mw.CONNECT:
@@ -63,21 +77,30 @@ class MqttBroker:
                 ptype, flags, body = mw.read_packet(conn)
                 if ptype == mw.SUBSCRIBE:
                     pid, topics = mw.parse_subscribe(body)
+                    # grant at most qos1 per filter (§3.9: return codes
+                    # echo the granted qos)
+                    granted = [(t, min(q, 1)) for t, q in topics]
                     with self._lock:
-                        subs, lock = self._subs.setdefault(
-                            conn, ([], threading.Lock()))
-                        subs.extend(topics)
+                        subs, lock, state = self._subs.setdefault(
+                            conn, ([], send_lock, {"pid": 0}))
+                        subs.extend(granted)
                     with lock:
-                        conn.sendall(
-                            mw.suback_packet(pid, [0] * len(topics)))
+                        conn.sendall(mw.suback_packet(
+                            pid, [q for _, q in granted]))
                 elif ptype == mw.PUBLISH:
-                    topic, payload = mw.parse_publish(flags, body)
-                    self._fan_out(topic, payload)
+                    topic, payload, qos, pid, _dup = \
+                        mw.parse_publish_full(flags, body)
+                    if qos == 1 and pid:
+                        # at-least-once inbound: ack BEFORE fan-out — on
+                        # a clean-session broker, ownership transfers at
+                        # receipt (mosquitto does the same)
+                        with send_lock:
+                            conn.sendall(mw.puback_packet(pid))
+                    self._fan_out(topic, payload, qos)
+                elif ptype == mw.PUBACK:
+                    pass  # subscriber confirmed a qos1 delivery
                 elif ptype == mw.PINGREQ:
-                    with self._lock:
-                        entry = self._subs.get(conn)
-                    lock = entry[1] if entry else threading.Lock()
-                    with lock:
+                    with send_lock:
                         conn.sendall(mw.pingresp_packet())
                 elif ptype == mw.DISCONNECT:
                     break
@@ -86,20 +109,31 @@ class MqttBroker:
         finally:
             with self._lock:
                 self._subs.pop(conn, None)
+                self._conns.discard(conn)
             try:
                 conn.close()
             except OSError:
                 pass
 
-    def _fan_out(self, topic: str, payload: bytes) -> None:
+    def _fan_out(self, topic: str, payload: bytes, qos: int = 0) -> None:
         with self._lock:
-            targets = [(c, lock) for c, (subs, lock) in self._subs.items()
-                       if any(mw.topic_matches(s, topic) for s in subs)]
-        pkt = mw.publish_packet(topic, payload)
-        for conn, lock in targets:
+            targets = []
+            for c, (subs, lock, state) in self._subs.items():
+                match_q = [q for s, q in subs if mw.topic_matches(s, topic)]
+                if match_q:
+                    # effective delivery qos = min(published, granted)
+                    targets.append((c, lock, state, min(qos, max(match_q))))
+        pkt0 = mw.publish_packet(topic, payload)
+        for conn, lock, state, out_q in targets:
             try:
                 with lock:  # serialize per subscriber, not globally
-                    conn.sendall(pkt)
+                    if out_q == 1:
+                        state["pid"] = (state["pid"] % 0xFFFF) + 1
+                        conn.sendall(mw.publish_packet(
+                            topic, payload, qos=1,
+                            packet_id=state["pid"]))
+                    else:
+                        conn.sendall(pkt0)
             except (ConnectionError, OSError):
                 with self._lock:
                     self._subs.pop(conn, None)

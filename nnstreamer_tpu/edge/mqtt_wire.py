@@ -3,10 +3,11 @@
 The reference's mqtt elements speak real MQTT through Eclipse Paho
 against a standard broker (ref: gst/mqtt/mqttsink.c:29 MQTTAsync usage);
 this module implements the needed subset of the MQTT 3.1.1 packet layer
-(CONNECT/CONNACK, SUBSCRIBE/SUBACK, PUBLISH qos0, PINGREQ/PINGRESP,
-DISCONNECT) from the public spec, so mqttsrc/mqttsink interop with
-mosquitto/Paho peers, and the in-process broker (edge/mqtt.py) accepts
-standard clients.
+(CONNECT/CONNACK, SUBSCRIBE/SUBACK, PUBLISH qos0 and qos1 with
+PUBACK/DUP redelivery, PINGREQ/PINGRESP, DISCONNECT) from the public
+spec, so mqttsrc/mqttsink interop with mosquitto/Paho peers, and the
+in-process broker (edge/mqtt.py) accepts standard clients. qos0 remains
+the default everywhere, matching the reference's mqttsink.
 
 Also provides the reference's tensor-message payload header layout
 (GstMQTTMessageHdr, ref: gst/mqtt/mqttcommon.h:49-63 — a 1024-byte
@@ -19,12 +20,14 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 from typing import List, Optional, Tuple
 
 # -- packet types (MQTT 3.1.1 §2.2.1) -----------------------------------------
 CONNECT = 0x1
 CONNACK = 0x2
 PUBLISH = 0x3
+PUBACK = 0x4
 SUBSCRIBE = 0x8
 SUBACK = 0x9
 UNSUBSCRIBE = 0xA
@@ -106,11 +109,26 @@ def suback_packet(packet_id: int, rcs: List[int]) -> bytes:
 
 
 def publish_packet(topic: str, payload: bytes, qos: int = 0,
-                   retain: bool = False) -> bytes:
-    if qos != 0:
-        raise NotImplementedError("qos>0 not supported (reference uses qos0 "
-                                  "default, mqttsink 'qos' prop)")
-    return _packet(PUBLISH, 0x1 if retain else 0, _utf8(topic) + payload)
+                   retain: bool = False, packet_id: Optional[int] = None,
+                   dup: bool = False) -> bytes:
+    """qos0 fire-and-forget or qos1 at-least-once (§3.3: packet id after
+    the topic, DUP set on retransmission). qos2 exactly-once is not
+    supported — the reference's mqttsink rides Paho with qos as a
+    property and the tensor-stream use case is at-least-once at most."""
+    if qos not in (0, 1):
+        raise NotImplementedError("qos2 (exactly-once) not supported")
+    flags = (0x8 if dup else 0) | (qos << 1) | (0x1 if retain else 0)
+    body = _utf8(topic)
+    if qos:
+        if not packet_id:
+            raise ValueError("qos1 publish requires a nonzero packet id")
+        body += struct.pack(">H", packet_id)
+    return _packet(PUBLISH, flags, body + payload)
+
+
+def puback_packet(packet_id: int) -> bytes:
+    """§3.4: the at-least-once acknowledgment for a qos1 PUBLISH."""
+    return _packet(PUBACK, 0, struct.pack(">H", packet_id))
 
 
 def pingreq_packet() -> bytes:
@@ -144,25 +162,37 @@ def read_packet(sock: socket.socket) -> Tuple[int, int, bytes]:
     return first >> 4, first & 0x0F, body
 
 
-def parse_publish(flags: int, body: bytes) -> Tuple[str, bytes]:
-    """(topic, payload) from a PUBLISH body; skips the packet id for
-    qos>0 senders so foreign publishers parse too."""
+def parse_publish_full(flags: int, body: bytes
+                       ) -> Tuple[str, bytes, int, Optional[int], bool]:
+    """(topic, payload, qos, packet_id, dup) from a PUBLISH packet."""
     tlen = struct.unpack(">H", body[:2])[0]
     topic = body[2:2 + tlen].decode("utf-8")
     off = 2 + tlen
     qos = (flags >> 1) & 0x3
+    dup = bool(flags & 0x8)
+    packet_id = None
     if qos:
+        packet_id = struct.unpack(">H", body[off:off + 2])[0]
         off += 2  # packet id present only for qos 1/2
-    return topic, body[off:]
+    return topic, body[off:], qos, packet_id, dup
 
 
-def parse_subscribe(body: bytes) -> Tuple[int, List[str]]:
+def parse_publish(flags: int, body: bytes) -> Tuple[str, bytes]:
+    """(topic, payload) from a PUBLISH body; skips the packet id for
+    qos>0 senders so foreign publishers parse too."""
+    topic, payload, _, _, _ = parse_publish_full(flags, body)
+    return topic, payload
+
+
+def parse_subscribe(body: bytes) -> Tuple[int, List[Tuple[str, int]]]:
+    """(packet_id, [(topic filter, requested qos), ...]) — §3.8."""
     packet_id = struct.unpack(">H", body[:2])[0]
     topics, off = [], 2
     while off < len(body):
         tlen = struct.unpack(">H", body[off:off + 2])[0]
-        topics.append(body[off + 2:off + 2 + tlen].decode("utf-8"))
-        off += 2 + tlen + 1  # skip requested qos byte
+        topic = body[off + 2:off + 2 + tlen].decode("utf-8")
+        topics.append((topic, body[off + 2 + tlen] & 0x3))
+        off += 2 + tlen + 1
     return packet_id, topics
 
 
@@ -215,22 +245,37 @@ def unpack_msg_hdr(data: bytes):
 # -- minimal blocking client --------------------------------------------------
 
 class MqttClient:
-    """A tiny synchronous MQTT 3.1.1 client (qos0), good enough for the
-    tensor stream elements: connect, subscribe, publish, recv_publish."""
+    """A tiny synchronous MQTT 3.1.1 client (qos0/qos1), good enough for
+    the tensor stream elements: connect, subscribe, publish (waiting for
+    PUBACK and retransmitting with DUP at qos1), recv_publish (PUBACKing
+    inbound qos1 deliveries). Single reader thread assumed — the
+    elements use one client per role."""
 
     # keepalive=0 disables the broker's idle timeout (§3.1.2.10): the
     # tensor elements have no ping loop, and a sparse publisher must not
     # be disconnected by a real mosquitto after 1.5x keepalive
     def __init__(self, host: str, port: int, client_id: str,
-                 timeout: float = 10.0, keepalive: int = 0):
+                 timeout: float = 10.0, keepalive: int = 0,
+                 ack_timeout: float = 5.0, max_retries: int = 2):
         self._sock = socket.create_connection((host, int(port)),
                                               timeout=timeout)
         self._send_lock = threading.Lock()
         self._packet_id = 0
         self._queued: List[Tuple[str, bytes]] = []
+        self._ack_timeout = ack_timeout
+        self._max_retries = max_retries
+        # receive buffer: partial packets survive a socket timeout (a
+        # multi-MB tensor PUBLISH interleaved with an ack wait must not
+        # be torn mid-body, or the stream desyncs permanently)
+        self._rxbuf = bytearray()
+        # qos1 publishes awaiting PUBACK: pid -> (topic, payload). On a
+        # dead connection these survive for take_unacked()/redeliver()
+        # on a fresh client — the at-least-once reconnect story (≙ Paho
+        # MQTTAsync redelivery, which the reference's mqttsink rides)
+        self._unacked: dict = {}
         try:
             self._sock.sendall(connect_packet(client_id, keepalive))
-            ptype, _, body = read_packet(self._sock)
+            ptype, _, body = self._read_packet()
             if ptype != CONNACK or len(body) < 2 or body[1] != 0:
                 raise ConnectionError(
                     f"mqtt: connect refused (type={ptype}, body={body!r})")
@@ -241,37 +286,171 @@ class MqttClient:
     def settimeout(self, t: Optional[float]) -> None:
         self._sock.settimeout(t)
 
-    def subscribe(self, topic: str) -> None:
+    # -- buffered packet reader (partial packets survive timeouts) --------
+    def _try_parse(self) -> Optional[Tuple[int, int, bytes]]:
+        buf = self._rxbuf
+        if len(buf) < 2:
+            return None
+        mult, length, i = 1, 0, 1
+        while True:
+            if i >= len(buf):
+                return None  # varint itself incomplete
+            b = buf[i]
+            length += (b & 0x7F) * mult
+            i += 1
+            if not b & 0x80:
+                break
+            mult *= 128
+            if i > 4:
+                raise ValueError("mqtt: malformed remaining length")
+        total = i + length
+        if len(buf) < total:
+            return None
+        first = buf[0]
+        body = bytes(buf[i:total])
+        del self._rxbuf[:total]  # buf aliases _rxbuf: extract first
+        return first >> 4, first & 0x0F, body
+
+    def _read_packet(self, timeout: Optional[float] = None
+                     ) -> Tuple[int, int, bytes]:
+        """Read one complete packet. ``timeout=None`` honors the
+        socket's configured timeout per recv; an explicit timeout is a
+        deadline for packet COMPLETION. Either way socket.timeout leaves
+        already-received bytes buffered — the stream stays in sync."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            pkt = self._try_parse()
+            if pkt is not None:
+                return pkt
+            if deadline is None:
+                chunk = self._sock.recv(65536)
+            else:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise socket.timeout("mqtt: packet wait timed out")
+                prev = self._sock.gettimeout()
+                self._sock.settimeout(remaining)
+                try:
+                    chunk = self._sock.recv(65536)
+                finally:
+                    try:
+                        self._sock.settimeout(prev)
+                    except OSError:
+                        pass
+            if not chunk:
+                raise ConnectionError("mqtt: connection closed")
+            self._rxbuf += chunk
+
+    def subscribe(self, topic: str, qos: int = 0) -> None:
         self._packet_id = (self._packet_id % 0xFFFF) + 1
         with self._send_lock:
-            self._sock.sendall(subscribe_packet(self._packet_id, [topic]))
+            self._sock.sendall(
+                subscribe_packet(self._packet_id, [topic], qos=qos))
         # the broker may interleave PUBLISHes before SUBACK (it registers
         # the subscription first); queue them for recv_publish — tolerate
         # means deliver, not discard
         while True:
-            ptype, flags, body = read_packet(self._sock)
+            ptype, flags, body = self._read_packet()
             if ptype == SUBACK:
                 if body[2:] and body[2] >= 0x80:
                     raise ConnectionError(f"mqtt: subscribe refused {body!r}")
                 return
             if ptype == PUBLISH:
-                self._queued.append(parse_publish(flags, body))
+                self._queued.append(self._accept_publish(flags, body))
+
+    def _accept_publish(self, flags: int, body: bytes) -> Tuple[str, bytes]:
+        """Parse an inbound PUBLISH, PUBACKing qos1 deliveries (§4.3.2:
+        at-least-once — ack after taking ownership; a DUP redelivery is
+        handed to the app, which is the qos1 contract)."""
+        topic, payload, qos, pid, _dup = parse_publish_full(flags, body)
+        if qos == 1 and pid:
+            with self._send_lock:
+                self._sock.sendall(puback_packet(pid))
+        return topic, payload
 
     def recv_publish(self) -> Tuple[str, bytes]:
         """Block until the next PUBLISH; answers PINGREQ in passing."""
         if self._queued:
             return self._queued.pop(0)
         while True:
-            ptype, flags, body = read_packet(self._sock)
+            ptype, flags, body = self._read_packet()
             if ptype == PUBLISH:
-                return parse_publish(flags, body)
+                return self._accept_publish(flags, body)
             if ptype == PINGREQ:
                 with self._send_lock:
                     self._sock.sendall(pingresp_packet())
 
-    def publish(self, topic: str, payload: bytes) -> None:
-        with self._send_lock:
-            self._sock.sendall(publish_packet(topic, payload))
+    def publish(self, topic: str, payload: bytes, qos: int = 0) -> None:
+        """qos0: fire and forget. qos1: block until the broker PUBACKs,
+        retransmitting with the DUP flag up to ``max_retries`` times on
+        ack timeout; raises ConnectionError when the message could not
+        be confirmed (it stays in :meth:`take_unacked` for redelivery
+        on a reconnected client)."""
+        if qos == 0:
+            with self._send_lock:
+                self._sock.sendall(publish_packet(topic, payload))
+            return
+        self._packet_id = (self._packet_id % 0xFFFF) + 1
+        pid = self._packet_id
+        self._unacked[pid] = (topic, payload)
+        self._publish_qos1(pid, topic, payload, dup=False)
+
+    def _publish_qos1(self, pid: int, topic: str, payload: bytes,
+                      dup: bool) -> None:
+        for attempt in range(self._max_retries + 1):
+            with self._send_lock:
+                self._sock.sendall(publish_packet(
+                    topic, payload, qos=1, packet_id=pid,
+                    dup=dup or attempt > 0))
+            try:
+                if self._wait_puback(pid, self._ack_timeout):
+                    return
+            except socket.timeout:
+                continue  # retransmit with DUP; partial rx stays buffered
+        raise ConnectionError(
+            f"mqtt: no PUBACK for packet {pid} after "
+            f"{self._max_retries + 1} attempts")
+
+    def _wait_puback(self, pid: int, timeout: float) -> bool:
+        """Read until the PUBACK for ``pid`` arrives; queue interleaved
+        PUBLISHes, answer pings. socket.timeout propagates (with any
+        half-read packet preserved in the rx buffer). The deadline is
+        checked per packet, so a broker streaming complete PUBLISHes
+        at high rate cannot stall the retransmit forever."""
+        deadline = time.monotonic() + timeout
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise socket.timeout("mqtt: puback wait timed out")
+            ptype, flags, body = self._read_packet(remaining)
+            if ptype == PUBACK and len(body) >= 2:
+                got = struct.unpack(">H", body[:2])[0]
+                self._unacked.pop(got, None)
+                if got == pid:
+                    return True
+            elif ptype == PUBLISH:
+                self._queued.append(self._accept_publish(flags, body))
+            elif ptype == PINGREQ:
+                with self._send_lock:
+                    self._sock.sendall(pingresp_packet())
+
+    def take_unacked(self) -> List[Tuple[str, bytes]]:
+        """Drain the qos1 messages this client could not confirm, in
+        send order — feed them to :meth:`redeliver` on a fresh client
+        after a reconnect."""
+        out = [self._unacked[k] for k in sorted(self._unacked)]
+        self._unacked.clear()
+        return out
+
+    def redeliver(self, messages: List[Tuple[str, bytes]]) -> None:
+        """Republish messages taken from a dead client's
+        :meth:`take_unacked`, DUP-flagged from the first transmission
+        (the receiver may already own them — at-least-once)."""
+        for topic, payload in messages:
+            self._packet_id = (self._packet_id % 0xFFFF) + 1
+            pid = self._packet_id
+            self._unacked[pid] = (topic, payload)
+            self._publish_qos1(pid, topic, payload, dup=True)
 
     def ping(self) -> None:
         with self._send_lock:

@@ -39,10 +39,20 @@ from ..utils.log import logger
 
 @register_element("mqttsink")
 class MqttSink(SinkElement):
+    # mqtt-qos: 0 (default, fire-and-forget — the reference mqttsink's
+    # DEFAULT_MQTT_QOS) or 1 (at-least-once: each publish waits for the
+    # broker's PUBACK and retransmits with DUP; unconfirmed frames are
+    # redelivered over a fresh connection). Named "mqtt-qos" exactly as
+    # the reference (mqttsink.c:314) because the base sink owns "qos"
+    # (latency-based frame dropping) — two different knobs.
+    # max-backlog bounds the qos1 hold queue during a broker outage:
+    # when full, the OLDEST frame drops (counted in stats) — unbounded
+    # retention would OOM the process on a long outage, losing
+    # everything instead of the tail
     PROPS = {"host": "localhost", "port": 1883, "pub-topic": "",
              "client-id": "", "ntp-sync": False,
              "ntp-srvs": "pool.ntp.org:123", "ntp-timeout": 2.0,
-             "debug": False}
+             "mqtt-qos": 0, "max-backlog": 256, "debug": False}
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
@@ -50,6 +60,20 @@ class MqttSink(SinkElement):
         self._caps_str = ""
         self._base_epoch_ns = 0
         self._base_mono_ns = 0
+        # qos1 frames not yet confirmed by any broker (send order);
+        # survives reconnect failures — at-least-once means held, not
+        # dropped, until a broker acks them (bounded by max-backlog)
+        self._q1_backlog: list = []
+        self._next_reconnect = 0.0
+        self.stats["backlog_dropped"] = 0
+
+    def _connect(self, timeout: float = 10.0) -> mw.MqttClient:
+        """The one connect site: start() and the qos1 reconnect must
+        never drift apart in connection options."""
+        return mw.MqttClient(
+            self.host, int(self.port),
+            self.client_id or f"nns-tpu-sink-{id(self):x}",
+            timeout=timeout)
 
     def start(self) -> None:
         super().start()
@@ -59,11 +83,12 @@ class MqttSink(SinkElement):
         self._base_epoch_ns = synced_epoch_ns(
             self.ntp_srvs if self.ntp_sync else None, self.ntp_timeout)
         self._base_mono_ns = time.monotonic_ns()
-        self._client = mw.MqttClient(
-            self.host, int(self.port),
-            self.client_id or f"nns-tpu-sink-{id(self):x}")
+        self._client = self._connect()
 
     def stop(self) -> None:
+        if self._q1_backlog:
+            logger.warning("%s: stopping with %d unconfirmed qos1 "
+                           "frame(s)", self.name, len(self._q1_backlog))
         if self._client is not None:
             self._client.close()
             self._client = None
@@ -82,7 +107,11 @@ class MqttSink(SinkElement):
 
     def render(self, buf: Buffer) -> None:
         client = self._client
-        if client is None:
+        if client is None and int(self.mqtt_qos) < 1:
+            # qos0 with no connection: fire-and-forget has nowhere to
+            # fire. qos1 proceeds WITHOUT a client — _flush_qos1 owns
+            # reconnection, and a frame rendered while the broker is
+            # down must be HELD in the backlog, not silently dropped
             return
         mems = [np.ascontiguousarray(c.host()).tobytes() for c in buf.chunks]
         pts = buf.pts
@@ -96,10 +125,56 @@ class MqttSink(SinkElement):
         hdr = mw.pack_msg_hdr([len(m) for m in mems], self._caps_str,
                               self._base_epoch_ns, sent_epoch,
                               buf.duration, buf.dts, pts)
-        client.publish(self.pub_topic, hdr + b"".join(mems))
+        payload = hdr + b"".join(mems)
+        if int(self.mqtt_qos) >= 1:
+            self._q1_backlog.append((self.pub_topic, payload))
+            self._flush_qos1()
+        else:
+            client.publish(self.pub_topic, payload)
         if self.debug:
             logger.info("%s: published pts=%s to %s", self.name, pts,
                         self.pub_topic)
+
+    def _flush_qos1(self) -> None:
+        """Drain the at-least-once backlog, reconnecting on a dead
+        broker link. Frames a dead client could not confirm are
+        reclaimed (take_unacked) and kept in order; a failed reconnect
+        HOLDS the backlog for the next render instead of dropping it,
+        and leaves no closed client behind to poison later sends.
+
+        Two stall guards keep the streaming thread live through an
+        outage: reconnects use a short (2 s) connect timeout and back
+        off for 1 s after a failure (frames keep accumulating in the
+        backlog meanwhile, they just don't each pay a connect attempt),
+        and the backlog is capped at max-backlog (oldest frame drops,
+        counted — bounded memory beats a certain OOM that would lose
+        every held frame anyway)."""
+        cap = max(1, int(self.max_backlog))
+        while len(self._q1_backlog) > cap:
+            self._q1_backlog.pop(0)
+            self.stats["backlog_dropped"] += 1
+        if self._client is None and time.monotonic() < self._next_reconnect:
+            return  # back off: let frames queue without a connect stall
+        for _attempt in range(2):
+            try:
+                if self._client is None:
+                    self._client = self._connect(timeout=2.0)
+                while self._q1_backlog:
+                    topic, payload = self._q1_backlog.pop(0)
+                    # on failure the message sits in client._unacked,
+                    # reclaimed below — popped-then-lost cannot happen
+                    self._client.publish(topic, payload, qos=1)
+                return
+            except (ConnectionError, OSError) as exc:
+                dead, self._client = self._client, None
+                if dead is not None:
+                    self._q1_backlog = dead.take_unacked() \
+                        + self._q1_backlog
+                    dead.close()
+                self._next_reconnect = time.monotonic() + 1.0
+                logger.warning("%s: qos1 publish failed (%s); %d "
+                               "frame(s) held for redelivery", self.name,
+                               exc, len(self._q1_backlog))
 
 
 @register_element("mqttsrc")
@@ -107,10 +182,15 @@ class MqttSrc(SrcElement):
     # is-live: accepted for launch-line compatibility (standard basesrc
     # prop on the reference's mqttsrc); this source is inherently live —
     # frames arrive from the broker in real time either way
+    # mqtt-qos: requested subscription qos (granted = min(1, requested)
+    # by the broker; qos1 deliveries are PUBACKed by the client layer).
+    # Reference-parity name (mqttsrc.c:291) — "qos" belongs to base-sink
+    # latency throttling, not to MQTT.
     PROPS = {"host": "localhost", "port": 1883, "sub-topic": "",
              "client-id": "", "ntp-sync": False,
              "ntp-srvs": "pool.ntp.org:123", "ntp-timeout": 2.0,
-             "timeout": 10.0, "is-live": True, "debug": False}
+             "timeout": 10.0, "is-live": True, "mqtt-qos": 0,
+             "debug": False}
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
@@ -133,7 +213,7 @@ class MqttSrc(SrcElement):
             self.client_id or f"nns-tpu-src-{id(self):x}",
             timeout=self.timeout)
         self._client.settimeout(self.timeout)
-        self._client.subscribe(self.sub_topic)
+        self._client.subscribe(self.sub_topic, qos=int(self.mqtt_qos))
         self._caps_sent = False
         super().start()
 

@@ -130,8 +130,12 @@ class LlmFilter(FilterFramework):
         self._stop.clear()
         # dispatch accounting: prompts of any length must cost ONE
         # prefill dispatch (≙ llamacpp n_batch), then one per token STEP
-        # (shared across n_parallel streams)
-        self.stats = {"prefill_dispatches": 0, "decode_dispatches": 0}
+        # (shared across n_parallel streams). decode_steps counts the
+        # ACTUAL weight-reading steps executed (a chunked dispatch runs
+        # an adaptive k <= chunk of them) — the honest multiplier for
+        # decode bandwidth accounting.
+        self.stats = {"prefill_dispatches": 0, "decode_dispatches": 0,
+                      "decode_steps": 0}
 
     def close(self) -> None:
         self._stop.set()
@@ -251,6 +255,7 @@ class LlmFilter(FilterFramework):
             logits, cache = self._decode(self._params, cache,
                                          tok.astype(jnp.int32))
             self.stats["decode_dispatches"] += 1
+            self.stats["decode_steps"] += 1
             pos += 1
 
     def _generate_chunked(self, logits, cache, pos, max_tokens, max_len,
@@ -283,6 +288,7 @@ class LlmFilter(FilterFramework):
             toks, logits, mcache, keys = self._chunk_fn(k, temperature)(
                 self._params, mcache, logits, keys, active)
             self.stats["decode_dispatches"] += 1
+            self.stats["decode_steps"] += k
             toks_host = np.asarray(toks)  # ONE fetch for k tokens
             for j in range(k):
                 emit(toks_host[j].astype(np.int32))
@@ -425,6 +431,7 @@ class LlmFilter(FilterFramework):
                 logits, cache = self._decode_multi(
                     self._params, cache, tok, jnp.asarray(active_np))
                 self.stats["decode_dispatches"] += 1
+                self.stats["decode_steps"] += 1
 
     def _sched_chunk(self, streams, active_np, logits, cache, max_len,
                      temperature):
@@ -461,6 +468,7 @@ class LlmFilter(FilterFramework):
         toks, logits, cache, keys = self._chunk_fn(k, temperature)(
             self._params, cache, logits, keys, jnp.asarray(active_np))
         self.stats["decode_dispatches"] += 1
+        self.stats["decode_steps"] += k
         toks_host = np.asarray(toks)  # [k, M]: ONE fetch for the chunk
         for slot, s in enumerate(streams):
             if s is None:

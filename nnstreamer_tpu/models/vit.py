@@ -30,12 +30,21 @@ class EncoderBlock(nn.Module):
     heads: int
     mlp_ratio: int = 4
     dtype: Any = jnp.bfloat16
+    # fused=True routes the attention core through the Pallas kernel
+    # (ops/attention.py): scores stay in VMEM instead of round-tripping
+    # HBM as a [B,H,S,S] tensor. Same math, same params, same output —
+    # a compile-time toggle, not a different model.
+    fused: bool = False
 
     @nn.compact
     def __call__(self, x):
         h = nn.LayerNorm(dtype=self.dtype)(x)
+        attn_kwargs = {}
+        if self.fused:
+            from ..ops.attention import fused_attention
+            attn_kwargs["attention_fn"] = fused_attention
         h = nn.MultiHeadDotProductAttention(
-            num_heads=self.heads, dtype=self.dtype)(h, h)
+            num_heads=self.heads, dtype=self.dtype, **attn_kwargs)(h, h)
         x = x + h
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h = nn.Dense(self.d_model * self.mlp_ratio, dtype=self.dtype)(h)
@@ -51,6 +60,7 @@ class ViT(nn.Module):
     heads: int = 12
     classes: int = 1000
     dtype: Any = jnp.bfloat16
+    fused: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -66,7 +76,7 @@ class ViT(nn.Module):
         x = x + pos.astype(self.dtype)
         for _ in range(self.layers):
             x = EncoderBlock(self.d_model, self.heads,
-                             dtype=self.dtype)(x)
+                             dtype=self.dtype, fused=self.fused)(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         x = x.mean(axis=1)  # mean-pool (no cls token: shape-stable)
         return nn.Dense(self.classes, dtype=jnp.float32)(
@@ -76,10 +86,25 @@ class ViT(nn.Module):
 @register_model("vit")
 def _build_vit(size: str = "224", patch: str = "16", d_model: str = "768",
                layers: str = "12", heads: str = "12",
-               classes: str = "1000", seed: str = "0"):
+               classes: str = "1000", seed: str = "0",
+               attn: str = "auto"):
+    """``attn``: ``stock`` (flax/XLA attention), ``pallas`` (the fused
+    VMEM kernel, ops/attention.py). The param tree is identical either
+    way — the toggle changes only how the attention core is scheduled.
+    ``auto`` resolves to stock: measured on v5e, XLA's pattern-matched
+    attention fusion beats the hand kernel at ViT encoder shapes
+    (ops/attention.py docstring carries the numbers); pallas stays
+    available for shapes where XLA's fusion breaks."""
     hw = int(size)
+    if attn == "auto":
+        attn = "stock"
+    if attn not in ("stock", "pallas"):
+        # a typo must not silently benchmark the wrong attention path
+        raise ValueError(f"vit: attn must be auto|stock|pallas, "
+                         f"got {attn!r}")
     model = ViT(patch=int(patch), d_model=int(d_model), layers=int(layers),
-                heads=int(heads), classes=int(classes))
+                heads=int(heads), classes=int(classes),
+                fused=(attn == "pallas"))
     dummy = jnp.zeros((1, hw, hw, 3), jnp.bfloat16)
     params = jit_init(model, seed, dummy)
 

@@ -89,8 +89,103 @@ def run(n_devices: int) -> float:
         assert jnp.isfinite(loss_u), f"non-finite ulysses loss {loss_u}"
         schemes = "ring+ulysses"
     print(f"dryrun_multichip: mesh dp={dp} sp={sp} tp={tp} "
-          f"seq={schemes} loss={float(loss):.4f} ok", flush=True)
+          f"seq={schemes} loss={float(loss):.4f} train ok", flush=True)
+    run_infer(n_devices)
     return float(loss)
+
+
+def run_infer(n_devices: int) -> None:
+    """Sharded *inference* round on the same virtual mesh (VERDICT r4
+    item 5 — the BASELINE config-5 story): several query clients stream
+    distinct frames to ONE server whose serversrc micro-batches them
+    (batch=4) into shared stacked invokes of a mesh-mode mobilenet
+    (batch dim on the ``data`` axis, params placed by rule table), and
+    the serversink row-routes replies back. Asserts (a) micro-batching
+    actually happened (< one invoke per frame and a stacked signature
+    compiled), (b) every client got ITS OWN frames' answers, in order,
+    bit-matching a single-device reference."""
+    ensure_devices(n_devices)
+    import socket
+    import threading
+    import time
+
+    import numpy as np
+
+    from nnstreamer_tpu import Buffer, parse_launch
+    from nnstreamer_tpu.filters import FilterProperties, find_filter
+
+    size = 96  # real conv stack, sized for the virtual CPU mesh
+    zoo = f"zoo://mobilenet_v2?size={size}"
+    caps = ('"other/tensors,format=static,num_tensors=1,'
+            f'types=(string)uint8,dimensions=(string)3:{size}:{size},'
+            'framerate=(fraction)0/1"')
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    dp = max(1, n_devices // 2)
+    server = parse_launch(
+        f"tensor_query_serversrc name=qs port={port} id=42 batch=4 "
+        f"! tensor_filter name=f framework=jax model={zoo} "
+        f'custom="mesh:{dp}x1x2" prefetch-host=true '
+        f"! tensor_query_serversink id=42")
+    server.start()
+    time.sleep(0.2)
+
+    ref = find_filter("jax")()
+    ref.open(FilterProperties(framework="jax", model_files=(zoo,)))
+    n_clients, frames_each = 3, 4
+    rng = np.random.default_rng(7)
+    xs = {(c, i): rng.integers(0, 255, (size, size, 3), np.uint8,
+                               endpoint=True)
+          for c in range(n_clients) for i in range(frames_each)}
+    want = {k: np.asarray(ref.invoke([v])[0]) for k, v in xs.items()}
+    ref.close()
+
+    results: dict = {}
+
+    def client(c):
+        cl = parse_launch(
+            f"appsrc name=in caps={caps} "
+            f"! tensor_query_client port={port} timeout=60 max-request=8 "
+            "! appsink name=out")
+        cl.start()
+        for i in range(frames_each):
+            cl["in"].push_buffer(Buffer.from_arrays([xs[(c, i)]]))
+        deadline = time.monotonic() + 300
+        while len(cl["out"].buffers) < frames_each \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        results[c] = [np.asarray(b.chunks[0].host()).copy()
+                      for b in cl["out"].buffers]
+        cl["in"].end_stream()
+        cl.stop()
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=320)
+    n_invokes = server["f"]._invoke_count
+    sigs = list(server["f"].fw._jit_cache)
+    server.stop()
+    total = n_clients * frames_each
+    assert n_invokes < total, \
+        f"no micro-batching: {n_invokes} invokes for {total} frames"
+    assert any(sig and sig[0][0] and sig[0][0][0] == 4 for sig in sigs), \
+        f"no stacked (batch=4) signature compiled: {sigs}"
+    for c in range(n_clients):
+        got = results.get(c, [])
+        assert len(got) == frames_each, \
+            f"client {c} got {len(got)}/{frames_each} replies"
+        for i, arr in enumerate(got):
+            np.testing.assert_allclose(
+                arr, want[(c, i)], rtol=1e-4, atol=1e-4,
+                err_msg=f"row-routing broke for client {c} frame {i}")
+    print(f"dryrun_multichip: mesh dp={dp} tp=2 query micro-batch=4 "
+          f"clients={n_clients} invokes={n_invokes}/{total} "
+          "row-routing infer ok", flush=True)
 
 
 if __name__ == "__main__":  # python -m nnstreamer_tpu.parallel.dryrun N

@@ -19,7 +19,8 @@ from typing import Any, Dict, List, Optional, Union
 from ..tensors.buffer import Buffer
 from ..tensors.caps import Caps
 from ..utils.log import logger
-from .events import CapsEvent, EosEvent, Event, FlushEvent, SegmentEvent, StreamStart
+from .events import (CapsEvent, EosEvent, Event, FlushEvent, QosEvent,
+                     SegmentEvent, StreamStart)
 from .pad import FlowError, Pad, PadDirection
 
 
@@ -319,12 +320,57 @@ class SrcElement(Element):
 
 
 class SinkElement(Element):
-    """Terminal element (≙ GstBaseSink); notifies the pipeline on EOS."""
+    """Terminal element (≙ GstBaseSink); notifies the pipeline on EOS.
+
+    ``qos=true`` measures each render against the stream's frame
+    duration and feeds QoS events upstream when the sink falls behind
+    (≙ GstBaseSink's "qos" property + gst_base_sink_send_qos). This is
+    the weather-adaptive loop on a tunnel-attached chip: a degrading
+    link inflates the host materialization inside render, the upstream
+    tensor_filter's throttle engages (tensor_filter.c:532-584 analog),
+    and queues drain by DROPPING at the filter — no invoke, no fetch
+    ticket, no ballooning backlog. Requires timestamped streams (a
+    framerate, hence buf.duration); untimed streams already self-limit
+    through bounded-queue backpressure."""
 
     SINK_TEMPLATES = {"sink": None}
+    PROPS = {"qos": False}
+
+    def __init__(self, name: Optional[str] = None, **props):
+        super().__init__(name, **props)
+        self._qos_avg_ns = 0.0
+        self._qos_throttling = False
+        self._qos_sent_ns = 0.0
 
     def do_chain(self, pad: Pad, buf: Buffer) -> None:
+        if not self.qos or not buf.duration:
+            self.render(buf)
+            return
+        t0 = time.perf_counter_ns()
         self.render(buf)
+        dt = time.perf_counter_ns() - t0
+        # EWMA over ~8 frames: tolerant of one-frame weather spikes,
+        # fast enough to catch a drifting link
+        self._qos_avg_ns += (dt - self._qos_avg_ns) * 0.125
+        proportion = self._qos_avg_ns / buf.duration
+        if proportion > 1.0:
+            # one event per throttle EPISODE (the flowctl.py:216
+            # convention), re-sent only when the sustainable period has
+            # drifted >25% — not one per slow frame
+            drift = abs(self._qos_avg_ns - self._qos_sent_ns) \
+                > 0.25 * self._qos_sent_ns
+            if not self._qos_throttling or drift:
+                self._qos_throttling = True
+                self._qos_sent_ns = self._qos_avg_ns
+                self.send_upstream_event(QosEvent(
+                    proportion=proportion,
+                    period_ns=int(self._qos_avg_ns), timestamp=buf.pts))
+        elif self._qos_throttling and proportion < 0.8:
+            # weather recovered (hysteresis): release the throttle
+            self._qos_throttling = False
+            self._qos_sent_ns = 0.0
+            self.send_upstream_event(QosEvent(
+                proportion=1.0, period_ns=0, timestamp=buf.pts))
 
     def render(self, buf: Buffer) -> None:
         raise NotImplementedError

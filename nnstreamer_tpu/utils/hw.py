@@ -79,19 +79,46 @@ _PEAK_BF16_TFLOPS = (
 )
 
 
-def peak_flops(device=None):
-    """Peak dense bf16 FLOPS/s for ``device`` (default: first jax
-    device), or None when the kind is unknown (e.g. CPU) — callers must
-    not fabricate an MFU from a guess."""
+def _match_peak(table, device, scale: float):
+    """Shared device-kind lookup for the peak tables: resolve the
+    device, require TPU, first substring match wins (the one place the
+    'v5 lite before v5' ordering rule lives)."""
     import jax
     d = device if device is not None else jax.devices()[0]
     if d.platform != "tpu":
         return None
     kind = getattr(d, "device_kind", "").lower()
-    for sub, tflops in _PEAK_BF16_TFLOPS:
+    for sub, value in table:
         if sub in kind:
-            return tflops * 1e12
+            return value * scale
     return None
+
+
+def peak_flops(device=None):
+    """Peak dense bf16 FLOPS/s for ``device`` (default: first jax
+    device), or None when the kind is unknown (e.g. CPU) — callers must
+    not fabricate an MFU from a guess."""
+    return _match_peak(_PEAK_BF16_TFLOPS, device, 1e12)
+
+
+# peak HBM bandwidth (bytes/s) per JAX DEVICE by device-kind substring,
+# same matching/convention rules as _PEAK_BF16_TFLOPS (public per-chip
+# figures: v2 700 GB/s, v3 900, v4 1228, v5e 819, v5p 2765, v6e 1640;
+# v2/v3 carry per-TensorCore halves since jax enumerates cores there)
+_PEAK_HBM_GBPS = (
+    ("v6 lite", 1640.0), ("v6e", 1640.0),
+    ("v5 lite", 819.0), ("v5litepod", 819.0), ("v5e", 819.0),
+    ("v5p", 2765.0), ("v5", 2765.0),
+    ("v4", 1228.0), ("v3", 450.0), ("v2", 350.0),
+)
+
+
+def peak_membw(device=None):
+    """Peak HBM bytes/s for ``device`` (default: first jax device), or
+    None when unknown — callers must not fabricate an MBU from a guess.
+    The honest denominator for decode-phase bandwidth utilization, the
+    generation-side analog of :func:`peak_flops`."""
+    return _match_peak(_PEAK_HBM_GBPS, device, 1e9)
 
 
 def is_available(kind: str) -> bool:

@@ -139,6 +139,36 @@ class TestMqttPacketGoldens:
         pkt = mw.publish_packet("t", b"\x01\x02")
         assert pkt == bytes.fromhex("30" "05" "000174" "0102")
 
+    def test_publish_qos1_packet_bytes(self):
+        from nnstreamer_tpu.edge import mqtt_wire as mw
+        pkt = mw.publish_packet("t", b"\x01\x02", qos=1, packet_id=9)
+        assert pkt == bytes.fromhex(
+            "32"        # PUBLISH, qos1 (flags 0b0010)
+            "07"        # remaining length
+            "000174"    # topic "t"
+            "0009"      # packet id 9
+            "0102")     # payload
+        # DUP retransmission sets bit 3 of the fixed-header flags
+        dup = mw.publish_packet("t", b"\x01\x02", qos=1, packet_id=9,
+                                dup=True)
+        assert dup == bytes.fromhex("3a" "07" "000174" "0009" "0102")
+        topic, payload, qos, pid, isdup = mw.parse_publish_full(
+            dup[0] & 0x0F, dup[2:])
+        assert (topic, payload, qos, pid, isdup) == (
+            "t", b"\x01\x02", 1, 9, True)
+
+    def test_puback_packet_bytes(self):
+        from nnstreamer_tpu.edge import mqtt_wire as mw
+        assert mw.puback_packet(9) == bytes.fromhex("40" "02" "0009")
+
+    def test_subscribe_qos1_packet_bytes(self):
+        from nnstreamer_tpu.edge import mqtt_wire as mw
+        pkt = mw.subscribe_packet(2, ["a/b"], qos=1)
+        assert pkt == bytes.fromhex(
+            "82" "08" "0002" "0003612f62" "01")  # requested qos 1
+        pid, topics = mw.parse_subscribe(pkt[2:])
+        assert pid == 2 and topics == [("a/b", 1)]
+
     def test_varint_boundaries(self):
         from nnstreamer_tpu.edge import mqtt_wire as mw
         import io
@@ -174,6 +204,138 @@ class TestMqttPacketGoldens:
         sizes, caps, base, sent, dur, dts, pts = mw.unpack_msg_hdr(hdr)
         assert (sizes, caps, base, sent, dur, dts, pts) == (
             [7, 9], "caps-str", 111, 222, 5, None, 42)
+
+
+def test_qos1_pub_sub_round_trip():
+    """qos=1 end to end against the in-repo broker: the sink's publishes
+    are PUBACKed, the subscriber receives qos1 deliveries (packet id on
+    the wire, auto-PUBACKed by the client layer), frames arrive intact
+    and in order."""
+    broker = MqttBroker(port=0).start()
+    sub = parse_launch(
+        f'mqttsrc port={broker.bound_port} sub-topic=edge/q1 mqtt-qos=1 '
+        'timeout=15 ! appsink name=out')
+    sub.start()
+    time.sleep(0.2)
+    pub = parse_launch(
+        f'appsrc name=in caps="{CAPS}" '
+        f'! mqttsink pub-topic=edge/q1 mqtt-qos=1 port={broker.bound_port}')
+    pub.start()
+    time.sleep(0.1)
+    for i in range(3):
+        pub["in"].push_buffer(Buffer.from_arrays(
+            [np.full(4, float(i), np.float32)]))
+    deadline = time.monotonic() + 10
+    while len(sub["out"].buffers) < 3 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    pub["in"].end_stream()
+    pub.stop()
+    sub.stop()
+    broker.stop()
+    got = [float(b.chunks[0].host()[0]) for b in sub["out"].buffers]
+    assert got == [0.0, 1.0, 2.0]
+
+
+class _FlakyAckBroker:
+    """Fake broker that accepts one client and PUBACKs qos1 publishes
+    only from the Nth attempt (drop_first acks withheld), recording the
+    DUP flag of every PUBLISH it sees."""
+
+    def __init__(self, drop_first: int = 1, close_instead: bool = False):
+        from nnstreamer_tpu.edge import mqtt_wire as mw
+        self._mw = mw
+        self.drop_first = drop_first
+        self.close_instead = close_instead
+        self.seen = []  # (packet_id, dup)
+        self.srv = socket.socket()
+        self.srv.bind(("localhost", 0))
+        self.srv.listen(4)
+        self.port = self.srv.getsockname()[1]
+        self._t = threading.Thread(target=self._serve, daemon=True)
+        self._t.start()
+
+    def _serve(self):
+        mw = self._mw
+        while True:
+            try:
+                conn, _ = self.srv.accept()
+            except OSError:
+                return
+            try:
+                ptype, _, _ = mw.read_packet(conn)
+                assert ptype == mw.CONNECT
+                conn.sendall(mw.connack_packet())
+                while True:
+                    ptype, flags, body = mw.read_packet(conn)
+                    if ptype != mw.PUBLISH:
+                        continue
+                    _t, _p, qos, pid, dup = mw.parse_publish_full(
+                        flags, body)
+                    self.seen.append((pid, dup))
+                    if qos == 1 and self.drop_first > 0:
+                        self.drop_first -= 1
+                        if self.close_instead:
+                            conn.close()
+                            break
+                        continue  # withhold the ack -> client retransmits
+                    if qos == 1:
+                        conn.sendall(mw.puback_packet(pid))
+            except (ConnectionError, OSError, AssertionError):
+                pass
+
+    def stop(self):
+        try:
+            self.srv.close()
+        except OSError:
+            pass
+
+
+def test_qos1_retransmits_with_dup_on_ack_timeout():
+    """A withheld PUBACK triggers retransmission of the SAME packet id
+    with the DUP flag set (§4.4), and publish() returns once acked."""
+    from nnstreamer_tpu.edge import mqtt_wire as mw
+    fake = _FlakyAckBroker(drop_first=1)
+    c = mw.MqttClient("localhost", fake.port, "dup-test",
+                      ack_timeout=0.3, max_retries=2)
+    c.publish("t", b"payload", qos=1)
+    c.close()
+    fake.stop()
+    assert fake.seen[0][1] is False          # first attempt: DUP clear
+    assert (fake.seen[0][0], True) in fake.seen[1:]  # retry: same id, DUP
+    assert c.take_unacked() == []            # confirmed -> nothing pending
+
+
+def test_qos1_redelivery_over_reconnect():
+    """A connection that dies before the PUBACK leaves the message in
+    take_unacked(); a fresh client redelivers it DUP-flagged and the
+    subscriber still receives it exactly as sent (at-least-once)."""
+    from nnstreamer_tpu.edge import mqtt_wire as mw
+    # phase 1: broker that kills the connection instead of acking
+    fake = _FlakyAckBroker(drop_first=1, close_instead=True)
+    c1 = mw.MqttClient("localhost", fake.port, "re-test",
+                       ack_timeout=0.3, max_retries=1)
+    try:
+        c1.publish("edge/re", b"precious", qos=1)
+        raised = False
+    except ConnectionError:
+        raised = True
+    assert raised
+    pending = c1.take_unacked()
+    assert pending == [("edge/re", b"precious")]
+    c1.close()
+    fake.stop()
+    # phase 2: real broker + subscriber; redeliver on a fresh client
+    broker = MqttBroker(port=0).start()
+    sub = mw.MqttClient("localhost", broker.bound_port, "re-sub")
+    sub.subscribe("edge/re", qos=1)
+    c2 = mw.MqttClient("localhost", broker.bound_port, "re-test2")
+    c2.redeliver(pending)
+    sub.settimeout(5.0)
+    topic, payload = sub.recv_publish()
+    sub.close()
+    c2.close()
+    broker.stop()
+    assert (topic, payload) == ("edge/re", b"precious")
 
 
 def test_interop_with_real_broker_if_present():
@@ -240,3 +402,94 @@ def test_ntp_fallback_when_unreachable():
     from nnstreamer_tpu.edge.ntp import best_offset
     # unroutable port: falls back to 0 offset (local clock)
     assert best_offset("localhost:1", timeout=0.2) == 0.0
+
+
+def test_qos1_ack_timeout_mid_large_publish_keeps_stream_sync():
+    """An ack wait that times out while a large interleaved PUBLISH is
+    mid-body must NOT desync the stream: the partial packet stays
+    buffered, the retransmit goes out, and both the large message and
+    the ack are eventually processed intact."""
+    import socket as _socket
+    from nnstreamer_tpu.edge import mqtt_wire as mw
+
+    srv = _socket.socket()
+    srv.bind(("localhost", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    big = bytes(range(256)) * 4096  # 1 MiB payload
+    seen = []
+
+    def serve():
+        conn, _ = srv.accept()
+        ptype, _, _ = mw.read_packet(conn)
+        assert ptype == mw.CONNECT
+        conn.sendall(mw.connack_packet())
+        # wait for the client's qos1 publish
+        ptype, flags, body = mw.read_packet(conn)
+        _t, _p, qos, pid, dup = mw.parse_publish_full(flags, body)
+        seen.append((pid, dup))
+        # interleave a LARGE qos0 publish, trickled: half now...
+        pkt = mw.publish_packet("bulk", big)
+        conn.sendall(pkt[:len(pkt) // 2])
+        time.sleep(0.7)  # ...client's 0.3s ack wait times out mid-body
+        # client retransmits (DUP); drain it
+        ptype, flags, body = mw.read_packet(conn)
+        _t, _p, _q, pid2, dup2 = mw.parse_publish_full(flags, body)
+        seen.append((pid2, dup2))
+        # now finish the big publish and ack
+        conn.sendall(pkt[len(pkt) // 2:])
+        conn.sendall(mw.puback_packet(pid))
+        time.sleep(0.2)
+        conn.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    c = mw.MqttClient("localhost", port, "sync-test",
+                      ack_timeout=0.3, max_retries=3)
+    c.publish("t", b"x", qos=1)       # survives the torn interleave
+    topic, payload = c.recv_publish()  # the big one arrives intact
+    c.close()
+    srv.close()
+    t.join(timeout=5)
+    assert (topic, payload) == ("bulk", big)
+    assert seen[0][1] is False and seen[1] == (seen[0][0], True)
+
+
+def test_qos1_sink_survives_broker_outage():
+    """mqtt-qos=1 sink vs a broker that dies and comes back: frames
+    published into the outage are HELD (not dropped, not crashing the
+    sink) and redelivered once the broker returns, in order."""
+    broker = MqttBroker(port=0).start()
+    port = broker.bound_port
+    pub = parse_launch(
+        f'appsrc name=in caps="{CAPS}" '
+        f'! mqttsink name=snk pub-topic=edge/out mqtt-qos=1 port={port}')
+    pub.start()
+    time.sleep(0.1)
+    pub["in"].push_buffer(Buffer.from_arrays([np.full(4, 0.0, np.float32)]))
+    time.sleep(0.3)   # frame 0 confirmed while the broker is alive
+    broker.stop()
+    time.sleep(0.2)
+    # frames 1-2 hit the dead broker: held in the sink's backlog
+    for i in (1.0, 2.0):
+        pub["in"].push_buffer(Buffer.from_arrays([np.full(4, i, np.float32)]))
+    time.sleep(0.5)
+    assert len(pub["snk"]._q1_backlog) >= 1
+    # broker returns on the SAME port; a subscriber attaches
+    broker2 = MqttBroker(port=port).start()
+    from nnstreamer_tpu.edge import mqtt_wire as mw
+    sub = mw.MqttClient("localhost", port, "outage-sub")
+    sub.subscribe("edge/out", qos=1)
+    sub.settimeout(10.0)
+    time.sleep(1.2)  # the sink's reconnect backoff (1 s) must expire
+    # next render flushes the backlog then the new frame
+    pub["in"].push_buffer(Buffer.from_arrays([np.full(4, 3.0, np.float32)]))
+    got = []
+    for _ in range(3):
+        _t, payload = sub.recv_publish()
+        got.append(float(np.frombuffer(payload[1024:], np.float32)[0]))
+    pub["in"].end_stream()
+    pub.stop()
+    sub.close()
+    broker2.stop()
+    assert got == [1.0, 2.0, 3.0]

@@ -142,3 +142,70 @@ class TestSparseElementsDevicePath:
             flat = TestSparsePack()._arr(density, n=64, seed=seed)
             out = dec.transform(enc.transform(Buffer([Chunk(flat)])))
             np.testing.assert_array_equal(out.chunks[0].host(), flat)
+
+
+class TestFusedAttention:
+    """ops/attention.py: the Pallas fused-attention kernel (VERDICT r4
+    item 3) — numerical parity with stock flax attention via the
+    interpreter on CPU, plus the fallback/dispatch contract."""
+
+    def _qkv(self, b=2, s=196, h=4, d=32, dtype=np.float32, seed=0):
+        import jax.numpy as jnp
+        rng = np.random.default_rng(seed)
+        mk = lambda: jnp.asarray(
+            rng.standard_normal((b, s, h, d)), dtype)
+        return mk(), mk(), mk()
+
+    def test_interpret_matches_flax(self):
+        import flax.linen as nn
+        import jax.numpy as jnp
+        from nnstreamer_tpu.ops.attention import fused_attention
+        q, k, v = self._qkv()
+        want = nn.dot_product_attention(q, k, v)
+        got = fused_attention(q, k, v, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-6)
+
+    def test_unpadded_tile_sizes_match(self):
+        """Sequence lengths off the 128-lane tile (the ViT 196 case)
+        and head dims below a lane must pad+mask correctly."""
+        import flax.linen as nn
+        from nnstreamer_tpu.ops.attention import fused_attention
+        for s, d in ((196, 64), (128, 128), (7, 8)):
+            q, k, v = self._qkv(b=1, s=s, h=2, d=d, seed=s)
+            want = nn.dot_product_attention(q, k, v)
+            got = fused_attention(q, k, v, interpret=True)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       atol=2e-6, err_msg=f"s={s} d={d}")
+
+    def test_mask_falls_back_to_stock(self):
+        """bias/mask are out of the kernel's contract: the wrapper must
+        return stock flax results, never silently ignore the mask."""
+        import flax.linen as nn
+        import jax.numpy as jnp
+        from nnstreamer_tpu.ops.attention import fused_attention
+        q, k, v = self._qkv(b=1, s=16, h=2, d=8)
+        mask = jnp.tril(jnp.ones((1, 2, 16, 16), bool))
+        want = nn.dot_product_attention(q, k, v, mask=mask)
+        got = fused_attention(q, k, v, mask=mask)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
+    def test_vit_attn_toggle_same_outputs(self):
+        """zoo://vit?attn=pallas and attn=stock share one param tree and
+        agree on logits to bf16 rounding (the fused path runs the
+        softmax in f32 — slightly BETTER numerics than stock bf16, so
+        exact equality is not the contract)."""
+        from nnstreamer_tpu.models import zoo
+        import jax
+        f_stock, p_stock, _, _ = zoo.build(
+            "vit", size="64", d_model="64", layers="2", heads="4",
+            classes="10", attn="stock")
+        f_pl, p_pl, _, _ = zoo.build(
+            "vit", size="64", d_model="64", layers="2", heads="4",
+            classes="10", attn="pallas")
+        assert jax.tree.structure(p_stock) == jax.tree.structure(p_pl)
+        frame = np.random.default_rng(1).integers(
+            0, 255, (64, 64, 3), np.uint8, endpoint=True)
+        a = np.asarray(f_stock(p_stock, frame))
+        b = np.asarray(f_pl(p_pl, frame))
+        np.testing.assert_allclose(a, b, rtol=0.05, atol=0.05)

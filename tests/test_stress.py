@@ -316,3 +316,49 @@ def test_concurrent_prefetch_pipelines_share_coalescer():
         for arr in results[tag][1:]:
             np.testing.assert_array_equal(arr, results[tag][0])
     assert not np.array_equal(results["a"][0], results["b"][0])
+
+
+def test_weather_adaptive_qos_bounded_under_slow_fetch(monkeypatch):
+    """Link weather degrades ~100x mid-stream (VERDICT r4 item 7): every
+    D2H fetch is slowed to 0.25 s. The sink's qos=true feedback engages
+    the tensor_filter's throttle, frames drop AT THE FILTER (counted in
+    qos_dropped — no invoke, no fetch ticket), and the fetch backlog
+    stays bounded instead of ballooning one ticket per source frame."""
+    import jax
+
+    from nnstreamer_tpu.pipeline.parser import parse_launch
+    from nnstreamer_tpu.tensors.fetch import fetch_stats
+
+    real_get = jax.device_get
+
+    def slow_get(tree):
+        time.sleep(0.25)  # ~100x a healthy coalesced fetch
+        return real_get(tree)
+
+    monkeypatch.setattr(jax, "device_get", slow_get)
+    fetch_stats(reset=True)
+    n = 60
+    capsq = ('"other/tensors,format=static,num_tensors=1,'
+             'types=(string)float32,dimensions=(string)64:8,'
+             'framerate=(fraction)30/1"')
+    pipe = parse_launch(
+        f"tensortestsrc caps={capsq} pattern=random is-live=true "
+        f"num-buffers={n} ! queue leaky=downstream max-size-buffers=4 "
+        "! tensor_filter name=f framework=jax model=zoo://mlp?dtype=float32 "
+        "prefetch-host=true ! queue max-size-buffers=4 "
+        "! appsink name=out qos=true")
+    delivered = []
+    pipe["out"].connect(lambda b: delivered.append(b.host_arrays()))
+    pipe.start()
+    assert pipe.wait_eos(timeout=120)
+    stats = dict(pipe["f"].stats)
+    pipe.stop()
+    s = fetch_stats()
+    # the throttle engaged: frames were dropped BEFORE invoke
+    assert stats["qos_dropped"] > 5, stats
+    # bounded backlog: far fewer fetch tickets than source frames (the
+    # unthrottled failure mode files one per frame = 60)
+    assert s["frames"] <= 35, s
+    assert len(delivered) == s["frames"]
+    # every delivered frame still fully materialized (no corruption)
+    assert all(a[0].shape == (8, 10) for a in delivered)
