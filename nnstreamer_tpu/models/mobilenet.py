@@ -104,10 +104,19 @@ class MobileNetV2(nn.Module):
 
 @register_model("mobilenet_v2")
 def _build_mobilenet_v2(width: str = "1.0", num_classes: str = "1001",
-                        size: str = "224", seed: str = "0"):
+                        size: str = "224", seed: str = "0",
+                        top1: str = "0"):
     """uint8 HWC frame in, float32 logits out; preprocessing ((x/127.5)-1)
-    is fused into the jitted graph so H2D moves uint8, not float."""
+    is fused into the jitted graph so H2D moves uint8, not float.
+
+    ``top1=1`` folds the class argmax into the XLA program and emits one
+    int32 id per frame instead of the [classes] logits — the TPU-first
+    device-decode option (like deeplab's ``argmax=u8`` and posenet's
+    ``decode=device``): for a labeling pipeline only 4 bytes/frame cross
+    the host link. The image_labeling decoder's logits mode stays the
+    parity path."""
     w, nc, hw = float(width), int(num_classes), int(size)
+    want_top1 = top1 not in ("0", "", "false")
     model = MobileNetV2(num_classes=nc, width=w)
     dummy = jnp.zeros((1, hw, hw, 3), jnp.bfloat16)
     variables = jit_init(model, seed, dummy)
@@ -118,8 +127,14 @@ def _build_mobilenet_v2(width: str = "1.0", num_classes: str = "1001",
         batched = frame.ndim == 4
         x = frame.astype(jnp.bfloat16) / 127.5 - 1.0
         logits = model.apply(params, x if batched else x[None])
+        if want_top1:
+            # keepdims: the per-frame tensor is [1] (int32 class id), so
+            # batched stacks are [B, 1] — matching out_info exactly
+            logits = jnp.argmax(logits, axis=-1,
+                                keepdims=True).astype(jnp.int32)
         return logits if batched else logits[0]
 
     in_info = TensorsInfo.make("uint8", f"3:{hw}:{hw}")
-    out_info = TensorsInfo.make("float32", str(nc))
+    out_info = TensorsInfo.make("int32", "1") if want_top1 \
+        else TensorsInfo.make("float32", str(nc))
     return apply_fn, variables, in_info, out_info

@@ -45,6 +45,9 @@ class _NativeQueueAdapter:
     def get_nowait(self):
         return self.get(timeout=0)
 
+    def close(self) -> None:
+        self._ring.close()
+
     def qsize(self) -> int:
         return len(self._ring)
 
@@ -118,17 +121,29 @@ class Queue(Element):
     def stop(self) -> None:
         self._running = False
         super().stop()
-        try:
-            self._q.put_nowait(_SENTINEL)
-        except _pyqueue.Full:
+        if isinstance(self._q, _NativeQueueAdapter):
+            # the C++ ring has real shutdown: close() wakes BOTH blocked
+            # producers (push returns 'closed') and the worker's pop.
+            # The sentinel dance below can lose a race against a
+            # producer re-filling the freed slot, wedging that producer
+            # in the native cv forever (observed under CPU load).
+            self._q.close()
+        else:
             try:
-                self._q.get_nowait()
                 self._q.put_nowait(_SENTINEL)
-            except (_pyqueue.Empty, _pyqueue.Full):
-                pass
+            except _pyqueue.Full:
+                try:
+                    self._q.get_nowait()
+                    self._q.put_nowait(_SENTINEL)
+                except (_pyqueue.Empty, _pyqueue.Full):
+                    pass
         if self._thread is not None and self._thread is not threading.current_thread():
             self._thread.join(timeout=5.0)
             self._thread = None
+        if isinstance(self._q, _NativeQueueAdapter):
+            # a closed ring stays closed: rebuild so a restarted element
+            # (rapid start/stop cycles) gets a live queue again
+            self._q = self._make_q()
 
     def chain(self, pad: Pad, item) -> None:
         if isinstance(item, Event):
@@ -173,7 +188,10 @@ class Queue(Element):
 
     def _worker(self) -> None:
         while self._running:
-            item = self._q.get()
+            try:
+                item = self._q.get()
+            except _pyqueue.Empty:
+                break  # native ring closed and drained
             if item is _SENTINEL:
                 break
             try:

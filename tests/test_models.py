@@ -210,3 +210,26 @@ def test_vit_forward_and_pipeline():
     bout = np.asarray(apply_fn(params, batch))
     assert bout.shape == (2, 10)
     np.testing.assert_allclose(bout[0], out, atol=1e-4)
+
+
+def test_mobilenet_top1_device_decode_matches_host_argmax():
+    """zoo://mobilenet_v2?top1=1 emits the int32 argmax of the logits
+    path in-graph — 4 bytes/frame D2H instead of [classes] floats —
+    for single frames and batched stacks alike."""
+    import numpy as np
+    from nnstreamer_tpu.models import zoo
+
+    f_log, p_log, _, out_log = zoo.build("mobilenet_v2", size="96")
+    f_t1, p_t1, _, out_t1 = zoo.build("mobilenet_v2", size="96", top1="1")
+    assert tuple(out_t1[0].shape) == (1,)
+    assert out_t1[0].type.np_dtype == np.int32
+    frame = np.random.default_rng(9).integers(
+        0, 255, (96, 96, 3), np.uint8, endpoint=True)
+    want = int(np.argmax(np.asarray(f_log(p_log, frame))))
+    got = np.asarray(f_t1(p_t1, frame))
+    assert got.shape == (1,) and int(got[0]) == want
+    stack = np.stack([frame, frame ^ 0xFF])
+    wants = np.argmax(np.asarray(f_log(p_log, stack)), axis=-1)
+    gots = np.asarray(f_t1(p_t1, stack))
+    assert gots.shape == (2, 1)
+    np.testing.assert_array_equal(gots[:, 0], wants)
