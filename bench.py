@@ -488,10 +488,12 @@ def bench_mobilenet_invoke(batch: int = 64):
 
 def bench_vit_invoke(batch: int = 64):
     """ViT-B/16 chained device-resident invoke: dense matmuls end to
-    end, the config where MFU approaches the MXU ceiling. Batch 64 and
-    a long chain (profiled best on the tunneled v5e; the chain is long
-    enough that the final forced fetch's RTT is noise)."""
-    return _chained_invoke_fps("vit", batch, scan_len=20, n_outer=6)
+    end, the config where MFU approaches the MXU ceiling. Batch 64,
+    long scans, FEW outer dispatches: each outer dispatch costs a link
+    round trip, so at ~100 ms RTT a chain of many short dispatches reads
+    10-20 MFU points low — weather noise, not the chip. 40x4 keeps
+    RPC overhead under ~10% of the wall in bad weather."""
+    return _chained_invoke_fps("vit", batch, scan_len=40, n_outer=4)
 
 
 def bench_matmul_roofline(n: int = 8192, scan_len: int = 64,
