@@ -49,6 +49,10 @@ BASELINE_FPS = 30.0
 # uses 96) must pass their own window to adjudicated() or the link
 # ceiling reads ~3x too tight.
 INFLIGHT_WINDOW = 32
+# the devres top1 row's deeper post-filter queue; ONE constant feeds
+# both the pipeline description and its adjudication window so they
+# cannot silently desync
+DEVRES_TOP1_WINDOW = 96
 
 
 def run_pipeline(desc: str, warmup: int, frames: int,
@@ -286,7 +290,8 @@ def bench_pipeline_devres(batch: int = 32, top1: bool = False):
     window (96 vs 32): the top1-vs-logits fps gap mixes those two
     effects, which is why each row carries its own window in its
     adjudication instead of inviting a direct division."""
-    q1, q2, n, warm = (16, 96, 560, 80) if top1 else (8, 32, 200, 40)
+    q1, q2, n, warm = ((16, DEVRES_TOP1_WINDOW, 560, 80) if top1
+                       else (8, 32, 200, 40))
     model = ('"zoo://mobilenet_v2?top1=1"' if top1
              else "zoo://mobilenet_v2")
     fps, p50 = run_pipeline(
@@ -493,9 +498,12 @@ def bench_mobilenet_invoke(batch: int = 64):
     """MobileNet-v2 sustained device-resident invoke (MLPerf-offline
     style), scan-chained so the chip really runs every step. Depthwise
     convs structurally under-fill the MXU: this row's MFU speaks for
-    MobileNet, not for the MXU (the matmul roofline row owns that)."""
-    return _chained_invoke_fps("mobilenet_v2", batch, scan_len=25,
-                               n_outer=4)
+    MobileNet, not for the MXU (the matmul roofline row owns that).
+    Long scans / few dispatches, like the ViT row: each outer dispatch
+    costs a link RTT and MobileNet's frames are cheap, so a short chain
+    reads mostly weather."""
+    return _chained_invoke_fps("mobilenet_v2", batch, scan_len=80,
+                               n_outer=3)
 
 
 def bench_vit_invoke(batch: int = 64):
@@ -733,7 +741,8 @@ def main() -> int:
                            lambda: bench_pipeline_devres(32, top1=True),
                            bytes_in_per_buffer=0,
                            bytes_out_per_buffer=32 * 4,
-                           frames_per_buffer=32, window=96)
+                           frames_per_buffer=32,
+                           window=DEVRES_TOP1_WINDOW)
         configs["devres_top1_batch32"] = row1
         extras["devres_top1_batch32_fps"] = row1["fps"]
         extras["pipeline_top1_vs_invoke_pct"] = round(
