@@ -244,7 +244,8 @@ def bench_mobilenet():
         f"tensortestsrc caps={caps('3:224:224')} pattern=random "
         "num-buffers=312 ! queue max-size-buffers=8 "
         "! tensor_filter framework=jax model=zoo://mobilenet_v2 latency=1 "
-        "prefetch-host=true ! queue max-size-buffers=32 "
+        "prefetch-host=true ! queue "
+        f"max-size-buffers={INFLIGHT_WINDOW} "
         "! appsink name=out", warmup=12, frames=300)
     return fps, p50
 
@@ -291,7 +292,7 @@ def bench_pipeline_devres(batch: int = 32, top1: bool = False):
     effects, which is why each row carries its own window in its
     adjudication instead of inviting a direct division."""
     q1, q2, n, warm = ((16, DEVRES_TOP1_WINDOW, 560, 80) if top1
-                       else (8, 32, 200, 40))
+                       else (8, INFLIGHT_WINDOW, 200, 40))
     model = ('"zoo://mobilenet_v2?top1=1"' if top1
              else "zoo://mobilenet_v2")
     fps, p50 = run_pipeline(
@@ -313,7 +314,8 @@ def bench_ssd(trace: dict | None = None, frames: int = 200):
         f"tensortestsrc caps={caps('3:300:300')} pattern=random "
         f"num-buffers={frames + 10} ! queue max-size-buffers=8 "
         '! tensor_filter framework=jax model="zoo://ssd_mobilenet_v2?packed=1" '
-        "prefetch-host=true ! queue max-size-buffers=32 "
+        "prefetch-host=true ! queue "
+        f"max-size-buffers={INFLIGHT_WINDOW} "
         "! tensor_decoder mode=bounding_boxes "
         "option1=mobilenet-ssd-postprocess option4=300:300 option5=300:300 "
         "! appsink name=out", warmup=10, frames=frames, trace=trace)
@@ -327,7 +329,8 @@ def bench_posenet():
         f"tensortestsrc caps={caps('3:257:257')} pattern=random "
         'num-buffers=210 ! queue max-size-buffers=8 '
         '! tensor_filter framework=jax model="zoo://posenet?decode=device" '
-        "prefetch-host=true ! queue max-size-buffers=32 "
+        "prefetch-host=true ! queue "
+        f"max-size-buffers={INFLIGHT_WINDOW} "
         "! tensor_decoder mode=pose_estimation option1=257:257 "
         "option2=257:257 ! appsink name=out", warmup=10, frames=200)
     return fps, p50
@@ -340,7 +343,8 @@ def bench_deeplab():
         f"tensortestsrc caps={caps('3:257:257')} pattern=random "
         "num-buffers=210 ! queue max-size-buffers=8 "
         '! tensor_filter framework=jax model="zoo://deeplab_v3?argmax=u8" '
-        "prefetch-host=true ! queue max-size-buffers=32 "
+        "prefetch-host=true ! queue "
+        f"max-size-buffers={INFLIGHT_WINDOW} "
         "! tensor_decoder mode=image_segment option1=tflite-deeplab "
         "! appsink name=out", warmup=10, frames=200)
     return fps, p50
@@ -374,7 +378,8 @@ def bench_query_fanout(n_clients: int = FANOUT_CLIENTS,
     server = parse_launch(
         f"tensor_query_serversrc port={port} id=90 batch={server_batch} "
         "! tensor_filter framework=jax model=zoo://mobilenet_v2 "
-        "prefetch-host=true ! queue max-size-buffers=32 "
+        "prefetch-host=true ! queue "
+        f"max-size-buffers={INFLIGHT_WINDOW} "
         "! tensor_query_serversink id=90")
     server.start()
     time.sleep(0.3)
@@ -805,8 +810,12 @@ def main() -> int:
         print(f"# llm_decode failed: {e}", file=sys.stderr)
         extras["llm_decode_tok_s"] = None
     try:
+        # 8 concurrent streams: each shared decode step serves all of
+        # them, so aggregate tok/s ~doubles over 4 streams (measured
+        # 1169 -> 1980) while steps/s — and thus MBU — barely moves;
+        # the params-bandwidth bound is per STEP, not per token
         toks, steps_s, pbytes = bench_llm_decode(
-            LLM_LARGE, n_prompts=4, streams=4, chunk=32, max_tokens=48)
+            LLM_LARGE, n_prompts=8, streams=8, chunk=32, max_tokens=48)
         extras["llm_large_decode_tok_s"] = round(toks, 1)
         extras["llm_large_params_gb"] = round(pbytes / 1e9, 2)
         extras["llm_large_steps_per_s"] = round(steps_s, 1)
