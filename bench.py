@@ -142,9 +142,12 @@ def probe_link_h2d_mbps(mb: int = 4) -> float:
     buf = np.random.default_rng(0).integers(
         0, 255, (mb << 20,), np.uint8, endpoint=True)
     jax.device_put(buf[:1024]).block_until_ready()  # warm the path
-    t0 = time.perf_counter()
-    jax.device_put(buf).block_until_ready()
-    return (mb << 20) / 1e6 / (time.perf_counter() - t0)
+    best = 0.0
+    for _ in range(2):  # best-of-2: one GC pause must not tank a probe
+        t0 = time.perf_counter()
+        jax.device_put(buf).block_until_ready()
+        best = max(best, (mb << 20) / 1e6 / (time.perf_counter() - t0))
+    return best
 
 
 def probe_link_d2h_mbps(mb: int = 4) -> float:
@@ -157,13 +160,17 @@ def probe_link_d2h_mbps(mb: int = 4) -> float:
     import numpy as np
 
     n = (mb << 20) // 4
-    dev = jax.jit(lambda s: jnp.arange(n, dtype=jnp.float32) + s)(1.0)
-    dev.block_until_ready()
-    t0 = time.perf_counter()
-    np.asarray(dev)
-    # true MB (1e6) so the ceiling's x1e6 is unit-consistent: reporting
-    # MiB as MB would understate every link ceiling by ~4.9%
-    return (mb << 20) / 1e6 / (time.perf_counter() - t0)
+    best = 0.0
+    for i in range(2):  # best-of-2, distinct results defeat caching
+        dev = jax.jit(lambda s: jnp.arange(n, dtype=jnp.float32) + s)(
+            float(i + 1))
+        dev.block_until_ready()
+        t0 = time.perf_counter()
+        np.asarray(dev)
+        # true MB (1e6) so the ceiling's x1e6 is unit-consistent:
+        # reporting MiB as MB would understate ceilings by ~4.9%
+        best = max(best, (mb << 20) / 1e6 / (time.perf_counter() - t0))
+    return best
 
 
 def probe_weather() -> dict:
@@ -200,29 +207,49 @@ def adjudicated(name: str, fn, bytes_in_per_buffer: int,
     reader of the JSON alone can tell link-capped from runtime-slow."""
     from nnstreamer_tpu.tensors.fetch import fetch_stats
 
-    try:
-        # a transient probe failure must not kill the measurement — the
-        # fps is the product; the adjudication fields degrade to null
-        weather = probe_weather()
-    except Exception as e:  # noqa: BLE001
-        print(f"# {name} weather probe failed: {e}", file=sys.stderr)
-        weather = None
+    def safe_probe():
+        try:
+            # a transient probe failure must not kill the measurement —
+            # the fps is the product; adjudication degrades to null
+            return probe_weather()
+        except Exception as e:  # noqa: BLE001
+            print(f"# {name} weather probe failed: {e}", file=sys.stderr)
+            return None
+
+    before = safe_probe()
     fetch_stats(reset=True)
     fps, p50 = fn()
     depth = fetch_stats()["frames_per_rpc_avg"]
+    after = safe_probe()
     row = {
         "name": name, "fps": round(fps, 2),
         "p50_frame_us": round(p50),
         "fetch_coalesce_avg": round(depth, 2),
     }
-    if weather is not None:
-        ceiling = link_ceiling_fps(weather, bytes_in_per_buffer,
+    probes = [w for w in (before, after) if w is not None]
+    if probes:
+        # the run is BRACKETED: an instantaneous pre-run probe can read
+        # far better than the weather the stream actually endured (the
+        # link swings mid-run), which would flip a link-starved run to
+        # 'missed'. The WORSE of the two ceilings is the bound (each
+        # probe is itself best-of-2 on bandwidth, so one transient blip
+        # cannot manufacture a low ceiling that excuses the runtime);
+        # both probes ship in the row so a reader can recompute either.
+        chosen = min(probes,
+                     key=lambda w: link_ceiling_fps(
+                         w, bytes_in_per_buffer, bytes_out_per_buffer,
+                         frames_per_buffer, window))
+        ceiling = link_ceiling_fps(chosen, bytes_in_per_buffer,
                                    bytes_out_per_buffer,
                                    frames_per_buffer, window)
         row.update({
-            "rtt_ms": weather["rtt_ms"],
-            "h2d_mbps": weather["h2d_mbps"],
-            "d2h_mbps": weather["d2h_mbps"],
+            # the scalars of the probe that PRODUCED the ceiling, so
+            # the row reproduces its own number
+            "rtt_ms": chosen["rtt_ms"],
+            "h2d_mbps": chosen["h2d_mbps"],
+            "d2h_mbps": chosen["d2h_mbps"],
+            "weather_before": before,
+            "weather_after": after,
             "link_ceiling_fps": round(ceiling, 1),
             # at >=70% of what the link permits, the LINK is the
             # binding constraint — the runtime cannot be blamed for
@@ -230,7 +257,8 @@ def adjudicated(name: str, fn, bytes_in_per_buffer: int,
             "weather_limited": bool(fps >= 0.7 * ceiling),
         })
     else:
-        row.update({"link_ceiling_fps": None, "weather_limited": None})
+        row.update({"weather_before": None, "weather_after": None,
+                    "link_ceiling_fps": None, "weather_limited": None})
     return row
 
 
