@@ -4,20 +4,27 @@
 CXX ?= g++
 CXXFLAGS ?= -O2 -fPIC -Wall -Wextra -std=c++17
 BUILD := build/native
+SHELL := /bin/bash
 
 LIB := $(BUILD)/libnnstpu.so
 EXAMPLES := $(BUILD)/custom_passthrough.so $(BUILD)/custom_scaler.so
 
-.PHONY: native clean test check lint package
+.PHONY: native clean test check tier1 lint package
 
 native: $(LIB) $(EXAMPLES)
 
 # `make check` = what CI runs on a clean checkout: native build + the
-# full test suite on the 8-virtual-device CPU mesh (tests/conftest.py
-# forces JAX_PLATFORMS=cpu) + a packaging sanity check.
+# non-slow test suite on the 8-virtual-device CPU mesh
+# (tests/conftest.py forces JAX_PLATFORMS=cpu) + a packaging sanity
+# check.
 check: native
-	python -m pytest tests/ -q
+	python -m pytest tests/ -q -m 'not slow'
 	python -c "import nnstreamer_tpu as nt; print('import ok:', len(nt.pipeline.registry.element_names()), 'elements')"
+
+# `make tier1` = the exact ROADMAP.md tier-1 verify gate, verbatim
+# (timeout, log tee, pass-dot count and all).
+tier1:
+	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 package:
 	python -m pip wheel --no-deps --no-build-isolation -w build/dist . \

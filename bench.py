@@ -457,6 +457,130 @@ def bench_query_fanout(n_clients: int = FANOUT_CLIENTS,
     return (n_all - n_warm) / (total["t1"] - total["t0"]), 0.0
 
 
+# -- serving stack: dynamic-batching scheduler vs per-request -----------------
+
+SERVE_CLIENTS = 8
+SERVE_BUCKETS = "1,2,4,8"
+SERVE_CLIENT_WINDOW = 16
+
+
+def _serve_fanout(server_desc: str, port: int, n_clients: int,
+                  warmup: int = 8, frames: int = 80):
+    """Drive ``n_clients`` concurrent query clients through a server
+    pipeline; returns (aggregate fps, server pipeline results dict).
+    Asserts zero lost/duplicated responses — a scheduler that sheds or
+    double-routes under this load is a failed run, not a slow one."""
+    import numpy as np
+
+    from nnstreamer_tpu import Buffer
+    from nnstreamer_tpu.pipeline.parser import parse_launch
+
+    server = parse_launch(server_desc)
+    server.start()
+    time.sleep(0.3)
+    total = {"n": 0, "t0": None, "t1": None}
+    tlock = threading.Lock()
+    done = threading.Event()
+    n_warm = warmup * n_clients
+    n_all = (warmup + frames) * n_clients
+
+    def on_buffer(_buf):
+        with tlock:
+            total["n"] += 1
+            if total["n"] == n_warm:
+                total["t0"] = time.perf_counter()
+            elif total["n"] == n_all:
+                total["t1"] = time.perf_counter()
+                done.set()
+
+    frame = np.random.default_rng(0).integers(
+        0, 255, (224, 224, 3), np.uint8, endpoint=True)
+
+    def run_client(idx):
+        client = parse_launch(
+            f"appsrc name=in caps={caps('3:224:224')} "
+            f"! tensor_query_client port={port} timeout=120 "
+            f"max-request={SERVE_CLIENT_WINDOW} "
+            "! appsink name=out")
+        client["out"].connect(on_buffer)
+        client.start()
+        for _ in range(warmup + frames):
+            client["in"].push_buffer(Buffer.from_arrays([frame]))
+        done.wait(timeout=600)
+        client["in"].end_stream()
+        client.stop()
+
+    threads = [threading.Thread(target=run_client, args=(i,))
+               for i in range(n_clients)]
+    for t in threads:
+        t.start()
+    ok = done.wait(timeout=600)
+    for t in threads:
+        t.join(timeout=30)
+    info = {}
+    for el in server.elements.values():
+        sched = getattr(el, "scheduler", None)
+        if sched is not None:
+            info["serve_report"] = sched.report()
+        fw = getattr(el, "fw", None)
+        if fw is not None and hasattr(fw, "_jit_cache"):
+            info["jit_compilations"] = len(fw._jit_cache)
+    server.stop()
+    if not ok or total["t0"] is None or total["t1"] is None:
+        raise RuntimeError(f"serve fan-out saw {total['n']} results")
+    return (n_all - n_warm) / (total["t1"] - total["t0"]), info
+
+
+def bench_serve_row(n_clients: int = SERVE_CLIENTS) -> dict:
+    """Serving-stack row (ISSUE 1 acceptance): N concurrent clients,
+    same model, batched scheduler path vs per-request path. The batched
+    side must win on aggregate throughput AND its jit cache must hold at
+    most len(buckets) compiled signatures (bucketed padding kept it
+    hot); the per-request side invokes once per frame."""
+    import socket as _socket
+
+    def free_port():
+        s = _socket.socket()
+        s.bind(("localhost", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    out: dict = {"serve_clients": n_clients, "serve_buckets": SERVE_BUCKETS}
+    p1 = free_port()
+    fps_b, info_b = _serve_fanout(
+        f"tensor_serve_src port={p1} id=95 buckets={SERVE_BUCKETS} "
+        "max-wait-ms=4 max-queue=64 "
+        "! tensor_filter framework=jax model=zoo://mobilenet_v2 "
+        "prefetch-host=true ! queue "
+        f"max-size-buffers={INFLIGHT_WINDOW} "
+        "! tensor_serve_sink id=95", p1, n_clients)
+    out["serve_batched_fps"] = round(fps_b, 1)
+    out["serve_jit_compilations"] = info_b.get("jit_compilations")
+    rep = info_b.get("serve_report") or {}
+    out["serve_occupancy_avg"] = round(rep.get("occupancy_avg", 0.0), 3)
+    out["serve_queue_delay_us"] = {
+        k: round(v) for k, v in rep.get("queue_delay_us", {}).items()}
+    out["serve_shed"] = (rep.get("shed_admission", 0)
+                         + rep.get("shed_deadline", 0))
+    n_buckets = len(SERVE_BUCKETS.split(","))
+    out["serve_jit_within_buckets"] = (
+        info_b.get("jit_compilations") is not None
+        and info_b["jit_compilations"] <= n_buckets)
+    # per-request comparator: the reference-shaped path, one invoke per
+    # connection-frame (query serversrc batch=0), same model
+    p2 = free_port()
+    fps_p, info_p = _serve_fanout(
+        f"tensor_query_serversrc port={p2} id=96 "
+        "! tensor_filter framework=jax model=zoo://mobilenet_v2 "
+        "prefetch-host=true ! queue "
+        f"max-size-buffers={INFLIGHT_WINDOW} "
+        "! tensor_query_serversink id=96", p2, n_clients)
+    out["serve_per_request_fps"] = round(fps_p, 1)
+    out["serve_speedup"] = round(fps_b / fps_p, 2) if fps_p else None
+    return out
+
+
 # -- device-resident invoke rows (measured-FLOP MFU) --------------------------
 
 def _compiled_flops(jf, *args) -> float:
@@ -810,6 +934,15 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001 -- one config must not kill the row
             print(f"# {name} failed: {e}", file=sys.stderr)
             extras[f"{name}_fps"] = None
+
+    # serving-stack row: bucketed dynamic batching vs per-request, same
+    # model, 8 concurrent clients. Comparative (A/B within one weather
+    # window), so not weather-adjudicated like the absolute rows above.
+    try:
+        extras.update(bench_serve_row())
+    except Exception as e:  # noqa: BLE001
+        print(f"# serve row failed: {e}", file=sys.stderr)
+        extras["serve_batched_fps"] = None
 
     # separate traced pass: tracer bookkeeping must not sit inside the
     # timed region of the fps row above. Long enough (120 frames vs ~40

@@ -40,6 +40,8 @@ class MsgKind(enum.IntEnum):
     QUERY = 9       # client -> broker: who serves this topic?
     QUERY_ACK = 10  # broker -> client: endpoint list
     PUBLISH = 11    # publisher -> message broker: topic payload
+    SHED = 12       # server -> client: request dropped (admission or
+                    # deadline); meta carries retry_after_ms + seq
 
 
 def _read_exact(sock: socket.socket, n: int) -> bytes:

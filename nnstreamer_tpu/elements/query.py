@@ -22,6 +22,7 @@ import numpy as np
 from ..edge.protocol import (MsgKind, buffer_to_wire, recv_msg, send_msg,
                              wire_to_buffer)
 from ..pipeline.element import Element, SinkElement, SrcElement
+from ..pipeline.events import QosEvent
 from ..pipeline.pad import Pad
 from ..pipeline.registry import register_element
 from ..tensors.buffer import Buffer, Chunk
@@ -182,9 +183,16 @@ class TensorQueryServerSrc(SrcElement):
                              daemon=True).start()
 
     def _client_loop(self, conn: socket.socket, cid: int) -> None:
+        # per-op timeout: a half-open peer (died without FIN) must not
+        # hold its recv thread — and its queued frames — forever; a
+        # live-but-idle client just times out between messages and loops
+        conn.settimeout(max(0.1, float(self.timeout)))
         try:
             while not self._stop_evt.is_set():
-                kind, meta, payloads = recv_msg(conn)
+                try:
+                    kind, meta, payloads = recv_msg(conn)
+                except TimeoutError:
+                    continue
                 if kind == MsgKind.CAPS:
                     out_caps = SERVER_TABLE.get_out_caps(self.id) or _FLEX_CAPS
                     send_msg(conn, MsgKind.CAPS_ACK,
@@ -198,10 +206,16 @@ class TensorQueryServerSrc(SrcElement):
                         self._qlock.notify_all()
                 elif kind == MsgKind.EOS:
                     break
-        except (ConnectionError, OSError):
+        except (ConnectionError, OSError, ValueError):
             pass
         finally:
             SERVER_TABLE.remove_conn(self.id, cid)
+            # slot reclamation: frames this client queued but the
+            # pipeline has not consumed would otherwise be invoked for a
+            # dead peer (and their replies dropped at the sink)
+            with self._qlock:
+                self._queue = [b for b in self._queue
+                               if b.extras.get("client_id") != cid]
             try:
                 conn.close()
             except OSError:
@@ -339,7 +353,12 @@ class TensorQueryClient(Element):
         self._conn_gen = 0
         self._last_caps: Optional[Caps] = None
         self._server_caps = _FLEX_CAPS
-        self.stats.update({"reconnects": 0})
+        # per-request wire correlation: serving servers (tensor_serve_*)
+        # echo it back on RESULT/SHED so out-of-order sheds settle the
+        # RIGHT pending entry; plain query servers ignore it and the
+        # client falls back to FIFO pairing
+        self._seq = 0
+        self.stats.update({"reconnects": 0, "shed": 0})
 
     def _endpoints(self, timeout: float) -> list:
         """Candidate servers, most preferred first."""
@@ -469,6 +488,7 @@ class TensorQueryClient(Element):
 
     def do_chain(self, pad: Pad, buf: Buffer) -> None:
         meta, payloads = buffer_to_wire(buf)
+        meta["seq"] = self._seq = self._seq + 1
         self._last_caps = pad.caps or self._last_caps
         entry = [meta, payloads, -1]  # -1 = not yet sent on any connection
         with self._plock:
@@ -523,12 +543,26 @@ class TensorQueryClient(Element):
                 logger.warning("%s: connection lost, reconnecting (%s)",
                                self.name, e)
 
+    def _settle_pending(self, seq) -> None:
+        """Mark the request a reply answers as no longer owed. Serving
+        servers echo our ``seq`` (sheds can overtake results, so FIFO
+        would settle the wrong entry); plain query servers don't, and
+        order-preserving FIFO remains correct there."""
+        with self._plock:
+            if seq is not None:
+                for i, entry in enumerate(self._pending):
+                    if entry[0].get("seq") == seq:
+                        del self._pending[i]
+                        return
+            if self._pending:
+                self._pending.popleft()
+
     def _recv_loop(self, sock: socket.socket,
                    inflight: threading.Semaphore) -> None:
         try:
             while not self._stop_evt.is_set():
                 kind, meta, payloads = recv_msg(sock)
-                if kind == MsgKind.RESULT:
+                if kind in (MsgKind.RESULT, MsgKind.SHED):
                     with self._conn_lock:
                         stale = sock is not self._sock
                     if stale:
@@ -537,9 +571,19 @@ class TensorQueryClient(Element):
                         # forwarding would duplicate it — and releasing
                         # would inflate the NEW semaphore's permit pool
                         continue
-                    with self._plock:
-                        if self._pending:
-                            self._pending.popleft()  # oldest is answered
+                    self._settle_pending(meta.get("seq"))
+                    if kind == MsgKind.SHED:
+                        # the server dropped this request (admission or
+                        # deadline): no result will come. Surface the
+                        # overload upstream as QoS with the server's
+                        # retry-after as the sustainable spacing hint.
+                        self.stats["shed"] += 1
+                        retry_ns = int(
+                            float(meta.get("retry_after_ms", 0.0)) * 1e6)
+                        self.send_upstream_event(QosEvent(
+                            proportion=2.0, period_ns=retry_ns))
+                        inflight.release()
+                        continue
                     # push before releasing: on_eos drains by acquiring all
                     # permits, so releasing first would let EOS overtake
                     # (and drop) this final result downstream

@@ -1,0 +1,20 @@
+"""tensor_serve — the dynamic-batching serving stack (L4).
+
+Sits between N concurrent client streams and one ``tensor_filter``:
+per-stream admission control feeds a bucketed batcher whose padded
+batches keep the filter's jit-executable cache hot (at most one compile
+per bucket), and a demux routes each batch row's result back to the
+stream that asked, by correlation id.
+
+The reference's among-device layer (tensor_query_*) RPCs one frame per
+connection straight into the filter; this package turns that into a
+serving stack: ``tensor_serve_src ! tensor_filter ! tensor_serve_sink``
+speaks the same wire protocol as ``tensor_query_client``, plus SHED
+replies (retry-after backpressure) when admission or deadlines drop a
+request.
+"""
+from .batcher import BucketBatcher, Request, stack_requests
+from .scheduler import SERVE_TABLE, ServeScheduler
+
+__all__ = ["BucketBatcher", "Request", "ServeScheduler", "SERVE_TABLE",
+           "stack_requests"]

@@ -1,0 +1,203 @@
+"""Dynamic-batching core: coalesce concurrent request streams into
+TPU-shaped batches.
+
+Three invariants drive the design:
+
+* **Bucketed sizes.** A batch is always padded up to one of a small set
+  of ``buckets`` (e.g. 1/2/4/8), so the downstream filter's jit cache
+  sees at most ``len(buckets)`` input signatures instead of one per
+  occupancy — on XLA a new signature is a multi-second compile, a padded
+  row is nearly free.
+* **Max-wait deadline.** A lone request never stalls waiting for
+  companions: the oldest queued request bounds how long a partial batch
+  may wait before it flushes at whatever occupancy it reached.
+* **Bounded admission.** Each stream owns a bounded queue slot budget;
+  a stream that outruns the TPU is shed at submit time (retry-after
+  backpressure) instead of growing an unbounded backlog, and a request
+  whose deadline expired before batching is shed rather than invoked.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class Request:
+    """One in-flight inference request with its reply route.
+
+    The object itself is the correlation id inside the process (batch
+    rows carry the ``Request``); ``stream_id``/``seq`` are the wire-level
+    correlation echoed back to remote clients.
+    """
+
+    __slots__ = ("stream_id", "seq", "arrays", "pts", "deadline",
+                 "on_result", "on_shed", "t_arrival", "t_batched")
+
+    def __init__(self, stream_id: Any, arrays: Sequence[Any], *,
+                 seq: Optional[int] = None, pts: Optional[int] = None,
+                 deadline: Optional[float] = None,
+                 on_result: Optional[Callable] = None,
+                 on_shed: Optional[Callable] = None):
+        self.stream_id = stream_id
+        self.arrays = [np.asarray(a) for a in arrays]
+        self.seq = seq
+        self.pts = pts
+        self.deadline = deadline          # absolute monotonic, None = none
+        self.on_result = on_result        # (request, [row arrays]) -> None
+        self.on_shed = on_shed            # (request) -> None
+        self.t_arrival = time.monotonic()
+        self.t_batched: Optional[float] = None
+
+    def signature(self):
+        return tuple((a.shape, a.dtype.str) for a in self.arrays)
+
+
+def stack_requests(requests: List[Request], bucket: int) -> List[np.ndarray]:
+    """Stack request tensors into leading-dim-``bucket`` arrays, padding
+    short batches by repeating the last row (one compiled signature per
+    bucket; a padded MXU row is nearly free next to a recompile)."""
+    rows = requests + [requests[-1]] * (bucket - len(requests))
+    return [np.stack([r.arrays[j] for r in rows])
+            for j in range(len(requests[0].arrays))]
+
+
+class BucketBatcher:
+    """Coalesces submitted requests into stackable, bucketed batches.
+
+    Thread-safe: any number of producers call :meth:`submit`; one
+    consumer (the serving loop) calls :meth:`next_batch`. Shed callbacks
+    fire outside the lock.
+    """
+
+    def __init__(self, buckets: Sequence[int] = (1, 2, 4, 8),
+                 max_wait_s: float = 0.005, max_queue: int = 16):
+        buckets = sorted({int(b) for b in buckets if int(b) > 0})
+        if not buckets:
+            raise ValueError("buckets must name at least one positive size")
+        self.buckets = buckets
+        self.max_wait_s = max(0.0, float(max_wait_s))
+        self.max_queue = max(1, int(max_queue))
+        self._cond = threading.Condition()
+        self._fifo: Deque[Request] = deque()
+        self._per_stream: Dict[Any, int] = {}
+        self.stats = {"submitted": 0, "batches": 0, "shed_admission": 0,
+                      "shed_deadline": 0, "cancelled": 0}
+
+    # -- producers ---------------------------------------------------------
+    def submit(self, req: Request) -> bool:
+        """Admit a request; False = shed at admission (the stream's queue
+        budget is exhausted — backpressure, the caller owes the client a
+        retry-after). The shed callback is NOT invoked here so the caller
+        can decide how to answer."""
+        with self._cond:
+            n = self._per_stream.get(req.stream_id, 0)
+            if n >= self.max_queue:
+                self.stats["shed_admission"] += 1
+                return False
+            self._per_stream[req.stream_id] = n + 1
+            self._fifo.append(req)
+            self.stats["submitted"] += 1
+            self._cond.notify_all()
+        return True
+
+    def cancel_stream(self, stream_id: Any) -> int:
+        """Reclaim every queued slot of a dead stream (client disconnect
+        mid-request must not wedge the batcher or leak its slots)."""
+        with self._cond:
+            kept = [r for r in self._fifo if r.stream_id != stream_id]
+            n = len(self._fifo) - len(kept)
+            self._fifo = deque(kept)
+            self._per_stream.pop(stream_id, None)
+            self.stats["cancelled"] += n
+        return n
+
+    def depth(self, stream_id: Any = None) -> int:
+        with self._cond:
+            if stream_id is None:
+                return len(self._fifo)
+            return self._per_stream.get(stream_id, 0)
+
+    # -- the consumer ------------------------------------------------------
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket >= n (the largest bucket caps a run)."""
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]
+
+    def next_batch(self, stop: Optional[threading.Event] = None,
+                   poll_s: float = 0.05) -> Optional[List[Request]]:
+        """Block until a batch is ready: the largest bucket fills with
+        stackable requests, or the oldest request's max-wait expires.
+        Expired-deadline requests are shed here (callbacks fire after the
+        lock drops). Returns None when ``stop`` is set."""
+        shed: List[Request] = []
+        try:
+            with self._cond:
+                while True:
+                    if stop is not None and stop.is_set():
+                        return None
+                    now = time.monotonic()
+                    self._shed_expired_locked(now, shed)
+                    if not self._fifo:
+                        self._cond.wait(timeout=poll_s)
+                        continue
+                    head = self._fifo[0]
+                    run = self._stackable_run(self.buckets[-1])
+                    flush_at = head.t_arrival + self.max_wait_s
+                    if run >= self.buckets[-1] or now >= flush_at:
+                        batch = [self._fifo.popleft() for _ in range(run)]
+                        for r in batch:
+                            n = self._per_stream.get(r.stream_id, 1) - 1
+                            if n <= 0:
+                                self._per_stream.pop(r.stream_id, None)
+                            else:
+                                self._per_stream[r.stream_id] = n
+                            r.t_batched = now
+                        self.stats["batches"] += 1
+                        return batch
+                    timeout = flush_at - now
+                    nearest = min((r.deadline for r in self._fifo
+                                   if r.deadline is not None), default=None)
+                    if nearest is not None:
+                        timeout = min(timeout, nearest - now)
+                    self._cond.wait(timeout=max(0.0, min(timeout, poll_s)))
+        finally:
+            for r in shed:
+                if r.on_shed is not None:
+                    r.on_shed(r)
+
+    def _shed_expired_locked(self, now: float, out: List[Request]) -> None:
+        if not any(r.deadline is not None and now >= r.deadline
+                   for r in self._fifo):
+            return
+        kept: List[Request] = []
+        for r in self._fifo:
+            if r.deadline is not None and now >= r.deadline:
+                out.append(r)
+                n = self._per_stream.get(r.stream_id, 1) - 1
+                if n <= 0:
+                    self._per_stream.pop(r.stream_id, None)
+                else:
+                    self._per_stream[r.stream_id] = n
+            else:
+                kept.append(r)
+        self._fifo = deque(kept)
+        self.stats["shed_deadline"] += len(out)
+
+    def _stackable_run(self, cap: int) -> int:
+        """Length of the stackable run at the head of the FIFO: requests
+        with a different tensor signature stay queued and open the NEXT
+        batch (heterogeneous clients work, they just don't share one)."""
+        head_sig = self._fifo[0].signature()
+        run = 1
+        for r in itertools.islice(self._fifo, 1, None):
+            if run >= cap or r.signature() != head_sig:
+                break
+            run += 1
+        return run

@@ -1,0 +1,249 @@
+"""tensor_serve_src / tensor_serve_sink — the serving-stack edge.
+
+``tensor_serve_src ! tensor_filter ... ! tensor_serve_sink`` is the
+server pipeline: the src accepts N concurrent clients speaking the same
+wire protocol as ``tensor_query_client``, admits each frame through the
+ServeScheduler (bounded per-stream queues), coalesces admitted requests
+into bucketed padded batches, and the sink demuxes each batch row's
+result back to the client that asked. Shed requests (admission or
+deadline) are answered immediately with a SHED message carrying a
+retry-after hint, which the query client surfaces as an upstream
+QosEvent.
+
+Against the per-request ``tensor_query_serversrc`` path this is the
+"serving stack": the jit cache sees at most ``len(buckets)`` signatures,
+a lone request flushes after ``max-wait-ms``, and a client that outruns
+the TPU is shed instead of growing an unbounded backlog.
+"""
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Dict, Optional, Tuple
+
+from ..edge.protocol import MsgKind, recv_msg, send_msg, wire_to_buffer
+from ..pipeline.element import SinkElement, SrcElement
+from ..pipeline.pad import Pad
+from ..pipeline.registry import register_element
+from ..tensors.buffer import Buffer, Chunk
+from ..tensors.caps import Caps
+from ..utils.log import logger
+from .batcher import Request
+from .scheduler import (ServeScheduler, get_scheduler, register_scheduler,
+                        unregister_scheduler)
+
+_FLEX_CAPS = "other/tensors,format=flexible"
+
+
+@register_element("tensor_serve_src")
+class TensorServeSrc(SrcElement):
+    """Serving entry: N client connections -> one bucketed batch stream.
+
+    Each created buffer is one padded batch: chunks carry the stacked
+    request tensors, ``serve_rows`` extras carry the originating
+    requests (the demux correlation), and ``batch_valid_rows`` tells the
+    filter how many rows are real (padded host rows are sliced off
+    before D2H, exactly like the query micro-batch path).
+    """
+
+    PROPS = {"host": "localhost", "port": 3001, "id": 0, "timeout": 10.0,
+             # bucketed batch sizes, ascending; one jit signature each
+             "buckets": "1,2,4,8",
+             # a partial batch flushes when its oldest request has
+             # waited this long (a lone request never stalls)
+             "max-wait-ms": 5.0,
+             # bounded per-stream queue: admission control / backpressure
+             "max-queue": 16,
+             # 0 = no deadline; else queued requests older than this are
+             # shed with a retry-after instead of invoked
+             "deadline-ms": 0.0,
+             # the retry-after hint carried by SHED replies
+             "retry-after-ms": 50.0}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._listener: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._next_client = [0]
+        # cid -> (conn, send lock): replies come from the sink's
+        # streaming thread, sheds from the batcher and recv threads —
+        # the per-connection lock keeps wire frames atomic
+        self._conns: Dict[int, Tuple[socket.socket, threading.Lock]] = {}
+        self._clock = threading.Lock()
+        self.scheduler: Optional[ServeScheduler] = None
+
+    @property
+    def bound_port(self) -> int:
+        return self._listener.getsockname()[1] if self._listener else self.port
+
+    def negotiate_src_caps(self) -> Optional[Caps]:
+        return Caps(_FLEX_CAPS)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self.scheduler = ServeScheduler(
+            buckets=[int(b) for b in str(self.buckets).split(",") if b],
+            max_wait_s=float(self.max_wait_ms) / 1e3,
+            max_queue=int(self.max_queue),
+            deadline_s=float(self.deadline_ms) / 1e3,
+            name=self.name)
+        register_scheduler(self.id, self.scheduler)
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((self.host, self.port))
+        self._listener.listen(64)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=f"serve-accept:{self.name}",
+            daemon=True)
+        self._accept_thread.start()
+        super().start()
+
+    def stop(self) -> None:
+        super().stop()
+        unregister_scheduler(self.id)
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+            self._listener = None
+        with self._clock:
+            victims = list(self._conns.values())
+            self._conns.clear()
+        for conn, _ in victims:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- client side -------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop_evt.is_set():
+            try:
+                conn, _addr = self._listener.accept()
+            except OSError:
+                return
+            cid = self._next_client[0]
+            self._next_client[0] += 1
+            with self._clock:
+                self._conns[cid] = (conn, threading.Lock())
+            threading.Thread(target=self._client_loop, args=(conn, cid),
+                             name=f"serve-client{cid}:{self.name}",
+                             daemon=True).start()
+
+    def _client_loop(self, conn: socket.socket, cid: int) -> None:
+        # a per-op timeout detects half-open (silently dead) peers: a
+        # live-but-idle client just times out between messages and loops
+        conn.settimeout(max(0.1, float(self.timeout)))
+        try:
+            while not self._stop_evt.is_set():
+                try:
+                    kind, meta, payloads = recv_msg(conn)
+                except TimeoutError:
+                    continue  # idle keep-alive; re-check stop
+                if kind == MsgKind.CAPS:
+                    send_msg(conn, MsgKind.CAPS_ACK,
+                             {"caps": _FLEX_CAPS, "client_id": cid})
+                elif kind == MsgKind.DATA:
+                    self._admit(conn, cid, meta, payloads)
+                elif kind == MsgKind.EOS:
+                    break
+        except (ConnectionError, OSError, ValueError):
+            pass
+        finally:
+            # slot reclamation: a stream that dies mid-request must not
+            # wedge the batcher or leak its queued slots
+            self._drop_client(cid)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _admit(self, conn: socket.socket, cid: int, meta, payloads) -> None:
+        buf = wire_to_buffer(meta, payloads)
+        self.scheduler.submit(
+            cid, [c.host() for c in buf.chunks],
+            seq=meta.get("seq"), pts=buf.pts,
+            on_result=self._on_result, on_shed=self._on_shed)
+
+    # -- reply side (called by the scheduler's demux) ----------------------
+    def _on_result(self, req: Request, row) -> None:
+        meta = {"pts": req.pts, "duration": None, "client_id": req.stream_id,
+                "seq": req.seq,
+                "tensors": [{"dtype": str(a.dtype), "shape": list(a.shape)}
+                            for a in row]}
+        self._send(req.stream_id, MsgKind.RESULT, meta,
+                   [a.tobytes() for a in row])
+
+    def _on_shed(self, req: Request) -> None:
+        # backpressure on the wire: the client translates this into an
+        # upstream QosEvent and a retry-after spacing hint
+        self._send(req.stream_id, MsgKind.SHED,
+                   {"pts": req.pts, "seq": req.seq,
+                    "client_id": req.stream_id,
+                    "retry_after_ms": float(self.retry_after_ms)})
+
+    def _send(self, cid, kind, meta, payloads=()) -> None:
+        with self._clock:
+            entry = self._conns.get(cid)
+        if entry is None:
+            logger.warning("%s: no connection for client %s", self.name, cid)
+            return
+        conn, lock = entry
+        try:
+            with lock:
+                send_msg(conn, kind, meta, payloads)
+        except (ConnectionError, OSError):
+            self._drop_client(cid)
+
+    def _drop_client(self, cid) -> None:
+        with self._clock:
+            self._conns.pop(cid, None)
+        if self.scheduler is not None:
+            n = self.scheduler.cancel_stream(cid)
+            if n:
+                logger.info("%s: client %s died, reclaimed %d queued "
+                            "slot(s)", self.name, cid, n)
+
+    # -- the src loop ------------------------------------------------------
+    def create(self) -> Optional[Buffer]:
+        if self.scheduler.tracer is None:
+            self.scheduler.tracer = getattr(self.pipeline, "tracer", None)
+        nb = self.scheduler.next_batch(self._stop_evt)
+        if nb is None:
+            return None
+        batch, _bucket, stacked = nb
+        out = Buffer([Chunk(x) for x in stacked], pts=batch[0].pts)
+        out.extras["serve_rows"] = batch
+        out.extras["serve_id"] = self.id
+        # the filter slices padded HOST rows off before any D2H
+        out.extras["batch_valid_rows"] = len(batch)
+        return out
+
+
+@register_element("tensor_serve_sink")
+class TensorServeSink(SinkElement):
+    """Serving exit: hands each result batch back to the scheduler's
+    demux, which routes row i to the stream that contributed input row i
+    (correlation rides IN the buffer as the originating requests)."""
+
+    PROPS = {"id": 0}
+
+    def handle_event(self, pad, event) -> None:
+        from ..pipeline.events import CapsEvent
+        if isinstance(event, CapsEvent):
+            pad.set_caps(event.caps)
+            return
+        super().handle_event(pad, event)
+
+    def render(self, buf: Buffer) -> None:
+        rows = buf.extras.get("serve_rows")
+        if not rows:
+            logger.warning("%s: buffer without serve_rows dropped", self.name)
+            return
+        sched = get_scheduler(buf.extras.get("serve_id", self.id))
+        hosts = [c.host() for c in buf.chunks]
+        if sched is None:
+            # server stopping: requests are orphaned, nothing to answer
+            return
+        sched.complete(rows, hosts)

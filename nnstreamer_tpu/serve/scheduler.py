@@ -1,0 +1,202 @@
+"""ServeScheduler: admission -> bucketed batch -> invoke -> demux.
+
+The scheduler owns the three moving parts of the serving stack: a
+:class:`~.batcher.BucketBatcher` (coalescing + admission + deadlines), a
+demux that routes each batch row's result back to its originating
+request by correlation, and per-batch metrics (occupancy, queue delay,
+batch latency, shed counts) kept in O(1)-memory reservoirs and — when a
+pipeline tracer is attached — mirrored into its report.
+
+Two embeddings:
+
+* **Pipeline elements** (``tensor_serve_src``/``tensor_serve_sink``):
+  the src loop calls :meth:`next_batch`, the filter invokes, the sink
+  calls :meth:`complete`. The pair find each other in :data:`SERVE_TABLE`
+  keyed by their ``id`` property.
+* **Standalone** (tests, embedding without a pipeline): construct with
+  ``invoke_fn`` and :meth:`start` a worker thread that drives
+  batch -> invoke -> demux itself.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils.log import logger
+from ..utils.trace import Reservoir
+from .batcher import BucketBatcher, Request, stack_requests
+
+# serve_src/serve_sink pairing by id (≙ the query elements' SERVER_TABLE)
+SERVE_TABLE: Dict[int, "ServeScheduler"] = {}
+_TABLE_LOCK = threading.Lock()
+
+
+def register_scheduler(sid: int, sched: "ServeScheduler") -> None:
+    with _TABLE_LOCK:
+        SERVE_TABLE[sid] = sched
+
+
+def unregister_scheduler(sid: int) -> None:
+    with _TABLE_LOCK:
+        SERVE_TABLE.pop(sid, None)
+
+
+def get_scheduler(sid: int) -> Optional["ServeScheduler"]:
+    with _TABLE_LOCK:
+        return SERVE_TABLE.get(sid)
+
+
+class ServeScheduler:
+    def __init__(self, buckets: Sequence[int] = (1, 2, 4, 8),
+                 max_wait_s: float = 0.005, max_queue: int = 16,
+                 deadline_s: float = 0.0,
+                 invoke_fn: Optional[Callable] = None,
+                 name: str = "serve"):
+        self.name = name
+        self.batcher = BucketBatcher(buckets, max_wait_s, max_queue)
+        self.deadline_s = max(0.0, float(deadline_s))
+        self._invoke_fn = invoke_fn
+        self._thread: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+        self.tracer = None  # optional utils.trace.Tracer (observe() sink)
+        self._mlock = threading.Lock()
+        self._queue_delay = Reservoir()
+        self._batch_latency = Reservoir()
+        self.stats = {"completed": 0, "rows_padded": 0, "bucket_rows": 0,
+                      "result_errors": 0}
+
+    # -- producers ---------------------------------------------------------
+    def submit(self, stream_id: Any, arrays: Sequence[Any], *,
+               seq: Optional[int] = None, pts: Optional[int] = None,
+               on_result: Optional[Callable] = None,
+               on_shed: Optional[Callable] = None,
+               deadline_s: Optional[float] = None) -> bool:
+        """Admit one request. False = shed at admission; the ``on_shed``
+        callback has already been invoked (retry-after is the caller's
+        wire-level answer)."""
+        dl = self.deadline_s if deadline_s is None else deadline_s
+        req = Request(stream_id, arrays, seq=seq, pts=pts,
+                      deadline=(time.monotonic() + dl) if dl > 0 else None,
+                      on_result=on_result, on_shed=on_shed)
+        if self.batcher.submit(req):
+            return True
+        if on_shed is not None:
+            on_shed(req)
+        return False
+
+    def cancel_stream(self, stream_id: Any) -> int:
+        return self.batcher.cancel_stream(stream_id)
+
+    # -- the batch side ----------------------------------------------------
+    def next_batch(self, stop: Optional[threading.Event] = None):
+        """Block for the next batch; returns (requests, bucket, stacked
+        arrays) or None when ``stop`` fires. Queue-delay and occupancy
+        metrics are recorded here (the batch is formed NOW)."""
+        batch = self.batcher.next_batch(stop)
+        if batch is None:
+            return None
+        bucket = self.batcher.bucket_for(len(batch))
+        now = time.monotonic()
+        with self._mlock:
+            for r in batch:
+                self._queue_delay.add((now - r.t_arrival) * 1e9)
+            self.stats["bucket_rows"] += bucket
+            self.stats["rows_padded"] += bucket - len(batch)
+        if self.tracer is not None:
+            for r in batch:
+                self.tracer.observe(f"{self.name}:queue_delay",
+                                    (now - r.t_arrival) * 1e9)
+        return batch, bucket, stack_requests(batch, bucket)
+
+    def complete(self, batch: List[Request], outputs: Sequence[Any]) -> None:
+        """Demux: row ``i`` of every output tensor goes back to the
+        request that contributed input row ``i`` (padded rows have no
+        request and are dropped). A failing per-row callback (its client
+        died mid-reply) must not starve the other rows of the batch."""
+        now = time.monotonic()
+        hosts = [np.asarray(o) for o in outputs]
+        for i, req in enumerate(batch):
+            row = [np.ascontiguousarray(h[i]) if h.ndim >= 1
+                   and h.shape[0] >= len(batch) else h for h in hosts]
+            if req.t_batched is not None:
+                lat_ns = (now - req.t_batched) * 1e9
+                with self._mlock:
+                    self._batch_latency.add(lat_ns)
+                if self.tracer is not None:
+                    self.tracer.observe(f"{self.name}:batch_latency", lat_ns)
+            if req.on_result is None:
+                continue
+            try:
+                req.on_result(req, row)
+            except Exception:  # noqa: BLE001 — one dead client, not a batch
+                with self._mlock:
+                    self.stats["result_errors"] += 1
+                logger.warning("%s: result callback failed for stream %s",
+                               self.name, req.stream_id, exc_info=True)
+        with self._mlock:
+            self.stats["completed"] += len(batch)
+
+    # -- metrics -----------------------------------------------------------
+    def report(self) -> Dict[str, Any]:
+        """Occupancy, queue delay and batch latency percentiles, shed
+        counts — the per-batch observability the ISSUE's serving stack
+        promises (also mirrored into an attached Tracer)."""
+        b = dict(self.batcher.stats)
+        with self._mlock:
+            s = dict(self.stats)
+            qd = self._queue_delay.percentiles()
+            bl = self._batch_latency.percentiles()
+        filled = s["bucket_rows"] - s["rows_padded"]
+        return {
+            "batches": b["batches"],
+            "requests": b["submitted"],
+            "completed": s["completed"],
+            "shed_admission": b["shed_admission"],
+            "shed_deadline": b["shed_deadline"],
+            "cancelled": b["cancelled"],
+            "result_errors": s["result_errors"],
+            "occupancy_avg": (filled / s["bucket_rows"]
+                              if s["bucket_rows"] else 0.0),
+            "queue_delay_us": {k: v / 1e3 for k, v in qd.items()},
+            "batch_latency_us": {k: v / 1e3 for k, v in bl.items()},
+        }
+
+    # -- standalone worker mode --------------------------------------------
+    def start(self) -> None:
+        """Spawn the worker loop (standalone embedding only: requires
+        ``invoke_fn``). Pipeline elements drive next_batch/complete
+        themselves and never call this."""
+        if self._invoke_fn is None:
+            raise ValueError(f"{self.name}: start() needs an invoke_fn")
+        self._stop_evt.clear()
+        self._thread = threading.Thread(target=self._worker,
+                                        name=f"serve:{self.name}",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        if self._thread is not None \
+                and self._thread is not threading.current_thread():
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def _worker(self) -> None:
+        while not self._stop_evt.is_set():
+            nb = self.next_batch(self._stop_evt)
+            if nb is None:
+                return
+            batch, _bucket, stacked = nb
+            try:
+                outputs = self._invoke_fn(stacked)
+            except Exception:  # noqa: BLE001 — shed the batch, keep serving
+                logger.warning("%s: invoke failed, batch shed", self.name,
+                               exc_info=True)
+                for r in batch:
+                    if r.on_shed is not None:
+                        r.on_shed(r)
+                continue
+            self.complete(batch, outputs)
