@@ -17,7 +17,7 @@ native: $(LIB) $(EXAMPLES)
 # non-slow test suite on the 8-virtual-device CPU mesh
 # (tests/conftest.py forces JAX_PLATFORMS=cpu) + a packaging sanity
 # check.
-check: native
+check: native lint
 	python -m pytest tests/ -q -m 'not slow'
 	python -c "import nnstreamer_tpu as nt; print('import ok:', len(nt.pipeline.registry.element_names()), 'elements')"
 
@@ -25,6 +25,13 @@ check: native
 # (timeout, log tee, pass-dot count and all).
 tier1:
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
+
+# `make lint` = static gates: bytecode-compile the package, then run
+# pipelint over every pipeline description in tests/ and README.md
+# (tools/lint_corpus.py exits nonzero on any severity=error finding).
+lint:
+	python -m compileall -q nnstreamer_tpu tools
+	env JAX_PLATFORMS=cpu python tools/lint_corpus.py
 
 package:
 	python -m pip wheel --no-deps --no-build-isolation -w build/dist . \

@@ -10,6 +10,11 @@ Introspection (≙ gst-inspect)::
     python -m nnstreamer_tpu --inspect              # list all elements
     python -m nnstreamer_tpu --inspect tensor_filter  # one element's props
     python -m nnstreamer_tpu --inspect-filters      # filter backends
+
+Static analysis (pipelint)::
+
+    python -m nnstreamer_tpu lint 'tensortestsrc ... ! fakesink'
+    python -m nnstreamer_tpu lint --json '<desc>'   # exit 0/1/2
 """
 from __future__ import annotations
 
@@ -84,6 +89,10 @@ def _run_broker(kind: str, port: int, timeout: float | None) -> int:
 
 
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    if argv and argv[0] == "lint":
+        from .analysis.cli import main as lint_main
+        return lint_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="python -m nnstreamer_tpu",
         description="Launch a tensor pipeline (gst-launch analog).")

@@ -1,0 +1,19 @@
+"""pipelint — static pipeline analysis (no element is ever started).
+
+Proves a parsed pipeline well-typed before PLAYING: propagates caps/
+shape/dtype through every element's declared transfer function
+(:meth:`Element.static_transfer`) and runs a set of graph lint rules
+(dangling pads, cycles, un-queued tee branches, jit-signature blowup,
+sharding divisibility, …). The same pass backs ``Pipeline.validate()``,
+the default pre-PLAYING gate, and ``python -m nnstreamer_tpu lint``.
+"""
+from .findings import (Finding, PipelineValidationError,  # noqa: F401
+                       Report, Severity)
+from .infer import InferenceResult, infer_caps  # noqa: F401
+from .rules import ALL_RULES, LintContext, Rule, analyze  # noqa: F401
+
+__all__ = [
+    "Severity", "Finding", "Report", "PipelineValidationError",
+    "InferenceResult", "infer_caps", "Rule", "LintContext", "ALL_RULES",
+    "analyze",
+]

@@ -1,0 +1,377 @@
+"""pipelint graph rules.
+
+Each :class:`Rule` inspects the parsed-but-unstarted pipeline plus the
+caps inference result and yields findings with element/pad locations.
+Rules never execute elements and never raise past :func:`analyze` — a
+broken rule must not block a launch.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Set
+
+from ..pipeline.element import Element, SinkElement, SrcElement
+from ..tensors.types import TensorFormat
+from ..utils.log import logger
+from .findings import Finding, Report, Severity
+from .infer import InferenceResult, config_of, infer_caps
+
+
+def kind_of(elem: Element) -> str:
+    return getattr(type(elem), "ELEMENT_NAME", type(elem).__name__.lower())
+
+
+@dataclass
+class LintContext:
+    pipeline: object
+    inference: InferenceResult
+
+    @property
+    def elements(self) -> List[Element]:
+        return list(self.pipeline.elements.values())
+
+    def of_kind(self, *kinds: str) -> List[Element]:
+        return [e for e in self.elements if kind_of(e) in kinds]
+
+    def downstream(self, elem: Element) -> Iterable[Element]:
+        for pad in elem.src_pads.values():
+            if pad.peer is not None:
+                yield pad.peer.element
+
+    def upstream(self, elem: Element) -> Iterable[Element]:
+        for pad in elem.sink_pads.values():
+            if pad.peer is not None:
+                yield pad.peer.element
+
+    def sources_feeding(self, elem: Element) -> List[Element]:
+        """Transitive upstream closure, returning the true sources."""
+        seen: Set[str] = set()
+        stack, out = [elem], []
+        while stack:
+            e = stack.pop()
+            if e.name in seen:
+                continue
+            seen.add(e.name)
+            ups = list(self.upstream(e))
+            if not ups and e is not elem and not e.sink_pads:
+                out.append(e)
+            stack.extend(ups)
+        return out
+
+
+class Rule:
+    """Base lint rule. ``id`` names the rule in findings; ``severity``
+    is the default used by :meth:`finding`."""
+
+    id = "rule"
+    severity = Severity.WARNING
+
+    def check(self, ctx: LintContext) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(self, message: str, element: Optional[str] = None,
+                pad: Optional[str] = None,
+                severity: Optional[Severity] = None) -> Finding:
+        return Finding(self.id,
+                       self.severity if severity is None else severity,
+                       message, element, pad)
+
+
+class DanglingPadRule(Rule):
+    """Static sink pads that were never linked: the element will wait
+    forever for data (crop's ``info`` pad, a combiner leg, ...).
+    Completely isolated elements are flagged too."""
+
+    id = "dangling-pad"
+    severity = Severity.WARNING
+
+    def check(self, ctx: LintContext):
+        for e in ctx.elements:
+            pads = list(e.sink_pads.values()) + list(e.src_pads.values())
+            linked = [p for p in pads if p.is_linked]
+            if pads and not linked:
+                yield self.finding(
+                    "element is not linked to anything", e.name)
+                continue
+            for pname, pad in e.sink_pads.items():
+                if not pad.is_linked:
+                    yield self.finding(
+                        f"sink pad {pname!r} is never linked; the element "
+                        f"waits on it forever", e.name, pname)
+
+
+class CycleRule(Rule):
+    """Cycles in the dataflow graph: buffers would chase their own tail
+    and caps can never settle."""
+
+    id = "cycle"
+    severity = Severity.ERROR
+
+    def check(self, ctx: LintContext):
+        cyc = ctx.inference.cyclic
+        if not cyc:
+            return
+        # restrict the blame to elements actually ON a cycle (Kahn also
+        # strands everything downstream of one)
+        by_name = {e.name: e for e in ctx.elements}
+        on_cycle = sorted(n for n in cyc if self._reaches_self(
+            by_name[n], by_name, cyc))
+        for name in on_cycle:
+            yield self.finding(
+                f"element is part of a dataflow cycle "
+                f"({' -> '.join(on_cycle)})", name)
+
+    @staticmethod
+    def _reaches_self(elem, by_name, cyc) -> bool:
+        seen: Set[str] = set()
+        stack = [p.peer.element for p in elem.src_pads.values()
+                 if p.peer is not None]
+        while stack:
+            e = stack.pop()
+            if e.name == elem.name:
+                return True
+            if e.name in seen or e.name not in cyc:
+                continue
+            seen.add(e.name)
+            stack.extend(p.peer.element for p in e.src_pads.values()
+                         if p.peer is not None)
+        return False
+
+
+class TeeNoQueueRule(Rule):
+    """A tee branch that reaches a sink without a queue runs serialized
+    with its sibling branches in one streaming thread — one slow/blocked
+    branch stalls them all (deadlock-prone with combiners downstream)."""
+
+    id = "tee-no-queue"
+    severity = Severity.WARNING
+
+    def check(self, ctx: LintContext):
+        from ..pipeline.basic import Queue
+        for tee in ctx.of_kind("tee"):
+            branches = [(n, p) for n, p in tee.src_pads.items()
+                        if p.peer is not None]
+            if len(branches) < 2:
+                continue
+            for pname, pad in branches:
+                if self._lacks_queue(pad.peer.element, Queue):
+                    yield self.finding(
+                        f"branch {pname!r} reaches a sink without a "
+                        f"queue; branches share one streaming thread",
+                        tee.name, pname)
+
+    @staticmethod
+    def _lacks_queue(start: Element, queue_cls) -> bool:
+        seen: Set[str] = set()
+        stack = [start]
+        while stack:
+            e = stack.pop()
+            if e.name in seen or isinstance(e, queue_cls):
+                continue
+            seen.add(e.name)
+            if isinstance(e, SinkElement):
+                return True
+            stack.extend(p.peer.element for p in e.src_pads.values()
+                         if p.peer is not None)
+        return False
+
+
+class JitSignatureRule(Rule):
+    """A tensor_filter fed by a dynamic-shape (flexible) upstream gets
+    one XLA compile per distinct shape. Bucketed sources bound the
+    signature count to len(buckets); anything else is unbounded."""
+
+    id = "jit-signatures"
+    severity = Severity.WARNING
+    bucket_budget = 8
+
+    def check(self, ctx: LintContext):
+        for filt in ctx.of_kind("tensor_filter"):
+            pad = filt.sink_pads.get("sink")
+            if pad is None or pad.peer is None:
+                continue
+            caps = ctx.inference.pad_caps.get(pad.peer)
+            cfg = config_of(caps)
+            if cfg is None or cfg.format != TensorFormat.FLEXIBLE:
+                continue  # static/unknown stream: nothing provable
+            srcs = ctx.sources_feeding(filt)
+            bounded = False
+            for src in srcs:
+                skind = kind_of(src)
+                if skind == "tensor_serve_src":
+                    buckets = [b for b in str(src.buckets).split(",") if b]
+                    bounded = True
+                    if len(buckets) > self.bucket_budget:
+                        yield self.finding(
+                            f"{len(buckets)} batch buckets exceed the "
+                            f"jit-signature budget of {self.bucket_budget} "
+                            f"(one compile each)", filt.name, "sink")
+                elif skind == "tensor_query_serversrc" \
+                        and int(getattr(src, "batch", 0)) > 0:
+                    bounded = True  # padded micro-batches: fixed signature
+            if not bounded:
+                origin = ", ".join(sorted(kind_of(s) for s in srcs)) \
+                    or "upstream"
+                yield self.finding(
+                    f"flexible-shape stream from {origin}: one jit "
+                    f"compile per distinct shape (unbounded signature "
+                    f"cardinality); bucket via tensor_serve_src or pin "
+                    f"dims with a capsfilter", filt.name, "sink")
+
+
+class ShardingRule(Rule):
+    """tensor_filter custom=mesh:DxSxT shards the batch over D data-
+    parallel devices; a batch not divisible by D fails at device_put."""
+
+    id = "sharding-divisibility"
+    severity = Severity.WARNING
+    _MESH = re.compile(r"(?:^|,)mesh:(\d+)x(\d+)x(\d+)")
+
+    def check(self, ctx: LintContext):
+        from ..tensors.info import TensorsInfo
+        for filt in ctx.of_kind("tensor_filter"):
+            m = self._MESH.search(str(filt.custom))
+            if not m:
+                continue
+            dp = int(m.group(1))
+            if dp <= 1:
+                continue
+            pad = filt.sink_pads.get("sink")
+            if pad is None or pad.peer is None:
+                continue
+            cfg = config_of(ctx.inference.pad_caps.get(pad.peer))
+            if cfg is None or cfg.format != TensorFormat.STATIC \
+                    or not len(cfg.info):
+                continue
+            stream = cfg.info[0]
+            if filt.input and filt.inputtype:
+                # declared model dims make the batch axis provable
+                try:
+                    model = TensorsInfo.make(filt.inputtype, filt.input)[0]
+                except ValueError:
+                    continue
+                if len(stream.shape) != len(model.shape) + 1:
+                    continue  # unbatched (or mismatched: caps rule's job)
+                batch = int(stream.shape[0])
+                if batch % dp:
+                    yield self.finding(
+                        f"batch {batch} is not divisible by the mesh's "
+                        f"data-parallel axis {dp} (custom="
+                        f"{filt.custom!r})", filt.name, "sink",
+                        severity=Severity.ERROR)
+            elif stream.shape and int(stream.shape[0]) % dp:
+                yield self.finding(
+                    f"leading dim {int(stream.shape[0])} is not divisible "
+                    f"by the mesh's data-parallel axis {dp}; if it is the "
+                    f"batch axis, device_put will fail", filt.name, "sink")
+
+
+class SinklessBranchRule(Rule):
+    """Data flowing into an element whose src pads go nowhere is
+    silently dropped; a pipeline with no sink at all never reaches
+    EOS."""
+
+    id = "sinkless-branch"
+    severity = Severity.WARNING
+
+    def check(self, ctx: LintContext):
+        elems = ctx.elements
+        if elems and not any(isinstance(e, SinkElement) for e in elems):
+            yield self.finding(
+                "pipeline has no sink element; wait_eos() would hang")
+        for e in elems:
+            if isinstance(e, SinkElement) or not e.src_pads:
+                continue
+            if any(p.is_linked for p in e.sink_pads.values()) \
+                    and not any(p.is_linked for p in e.src_pads.values()):
+                yield self.finding(
+                    "branch dead-ends here: no src pad is linked, "
+                    "buffers are dropped", e.name)
+
+
+class CombinerDtypeRule(Rule):
+    """tensor_merge np.concatenate's its legs — mismatched dtypes would
+    silently upcast (or fail) at runtime; join forwards the first leg's
+    caps, so a differing leg violates them mid-stream."""
+
+    id = "combiner-dtype"
+    severity = Severity.ERROR
+
+    def check(self, ctx: LintContext):
+        from ..elements.combiner import pad_sort_key
+        for comb in ctx.of_kind("tensor_merge", "join"):
+            kind = kind_of(comb)
+            legs = []
+            for pname in sorted(comb.sink_pads, key=pad_sort_key):
+                pad = comb.sink_pads[pname]
+                if pad.peer is None:
+                    continue
+                cfg = config_of(ctx.inference.pad_caps.get(pad.peer))
+                if cfg is not None and len(cfg.info):
+                    legs.append((pname, cfg))
+            if len(legs) < 2:
+                continue
+            ref_name, ref = legs[0]
+            for pname, cfg in legs[1:]:
+                dtypes = [i.type for i in cfg.info]
+                ref_dtypes = [i.type for i in ref.info]
+                if dtypes != ref_dtypes:
+                    yield self.finding(
+                        f"dtype {[str(t) for t in dtypes]} differs from "
+                        f"{ref_name!r}'s {[str(t) for t in ref_dtypes]}; "
+                        f"{kind} would silently widen or corrupt",
+                        comb.name, pname)
+                elif kind == "join" and not cfg.info.is_equal(ref.info):
+                    yield self.finding(
+                        f"shape differs from {ref_name!r} "
+                        f"({cfg.info!r} vs {ref.info!r}); join forwards "
+                        f"one caps for all legs", comb.name, pname)
+
+
+class UnboundedAdmissionRule(Rule):
+    """Serving entry points must bound admission: an unbounded queue
+    turns an overloaded server into a memory leak with unbounded tail
+    latency instead of shedding load."""
+
+    id = "unbounded-admission"
+    severity = Severity.WARNING
+
+    def check(self, ctx: LintContext):
+        for e in ctx.of_kind("tensor_serve_src"):
+            if int(e.max_queue) <= 0:
+                yield self.finding(
+                    f"max-queue={int(e.max_queue)} disables admission "
+                    f"control (clamped to 1 silently); set a real bound",
+                    e.name)
+            if float(e.deadline_ms) < 0:
+                yield self.finding(
+                    "negative deadline-ms sheds every request", e.name)
+        for e in ctx.of_kind("tensor_query_serversrc"):
+            yield self.finding(
+                "per-request path has no admission control or shedding; "
+                "production traffic belongs on tensor_serve_src",
+                e.name, severity=Severity.INFO)
+
+
+ALL_RULES: List[Rule] = [
+    DanglingPadRule(), CycleRule(), TeeNoQueueRule(), JitSignatureRule(),
+    ShardingRule(), SinklessBranchRule(), CombinerDtypeRule(),
+    UnboundedAdmissionRule(),
+]
+
+
+def analyze(pipeline, rules: Optional[List[Rule]] = None) -> Report:
+    """Run caps inference + every rule over ``pipeline``; returns the
+    aggregated :class:`Report`. Never starts an element."""
+    inference = infer_caps(pipeline)
+    report = Report(findings=list(inference.findings),
+                    num_elements=len(pipeline.elements))
+    ctx = LintContext(pipeline, inference)
+    for rule in (ALL_RULES if rules is None else rules):
+        try:
+            report.findings.extend(rule.check(ctx))
+        except Exception:  # noqa: BLE001 -- a broken rule must not block launch
+            logger.warning("pipelint: rule %s crashed; skipped",
+                           rule.id, exc_info=True)
+    return report

@@ -22,7 +22,7 @@ from typing import Deque, Dict, List, Optional
 
 import numpy as np
 
-from ..pipeline.element import Element
+from ..pipeline.element import Element, TransferError
 from ..pipeline.events import CapsEvent, EosEvent, Event
 from ..pipeline.pad import Pad
 from ..pipeline.registry import register_element
@@ -92,7 +92,31 @@ class _CollectBase(Element):
             self.forward_event(event)  # segment/stream-start from first pad
 
     def _combined_config(self) -> Optional[TensorsConfig]:
+        return self._combine_configs(
+            [self._state(p).config for p in self._pads_in_order()])
+
+    def _combine_configs(
+            self, cfgs: List[TensorsConfig]) -> Optional[TensorsConfig]:
+        """Pure N-config -> combined-config computation; shared by the
+        runtime caps path and pipelint."""
         raise NotImplementedError
+
+    def static_transfer(self, in_caps):
+        """Combine the per-leg declared configs (legs in pad order)."""
+        cfgs = []
+        for pname in sorted(in_caps, key=pad_sort_key):
+            caps = in_caps[pname]
+            if caps is None or caps.any or not caps.structures \
+                    or not caps.is_fixed():
+                return {"src": None}
+            try:
+                cfgs.append(caps.to_config())
+            except ValueError as exc:
+                raise TransferError(f"{self.name}: {exc}", pad=pname)
+        if not cfgs:
+            return {"src": None}
+        cfg = self._combine_configs(cfgs)
+        return {"src": Caps.from_config(cfg) if cfg is not None else None}
 
     def _maybe_send_caps(self) -> None:
         if self._caps_sent:
@@ -277,9 +301,7 @@ class TensorMux(_CollectBase):
     """N tensor streams -> one stream whose num_tensors is the sum
     (≙ gsttensor_mux.c)."""
 
-    def _combined_config(self) -> Optional[TensorsConfig]:
-        pads = self._pads_in_order()
-        cfgs = [self._state(p).config for p in pads]
+    def _combine_configs(self, cfgs) -> Optional[TensorsConfig]:
         info = TensorsInfo()
         fmt = TensorFormat.STATIC
         for c in cfgs:
@@ -311,9 +333,7 @@ class TensorMerge(_CollectBase):
             return 0
         return ndim - 1 - ref_dim
 
-    def _combined_config(self) -> Optional[TensorsConfig]:
-        pads = self._pads_in_order()
-        cfgs = [self._state(p).config for p in pads]
+    def _combine_configs(self, cfgs) -> Optional[TensorsConfig]:
         infos = [c.info[0] for c in cfgs]
         base = infos[0]
         ndim = max(len(i.shape) for i in infos)
@@ -372,3 +392,11 @@ class Join(Element):
 
     def do_chain(self, pad: Pad, buf: Buffer) -> None:
         self.srcpad.push(buf)
+
+    def static_transfer(self, in_caps):
+        """First leg's caps when every known leg agrees; differing legs
+        are unknown here (the combiner-dtype rule reports them)."""
+        known = [c for c in in_caps.values() if c is not None]
+        if not known or any(c != known[0] for c in known[1:]):
+            return {"src": None}
+        return {"src": known[0]}

@@ -44,13 +44,22 @@ class TensorConverter(TransformElement):
     # -- negotiation ------------------------------------------------------
     def on_sink_caps(self, pad: Pad, caps: Caps) -> None:
         pad.set_caps(caps)
-        s = caps.structures[0]
-        self._media = s.name
+        self._media = caps.structures[0].name
         if self.mode:
             kind, _, arg = self.mode.partition(":")
             self._custom = find_converter(kind, arg)
-            cfg = self._custom.get_out_config(caps)
-        elif s.name == "video/x-raw":
+            cfg = self._apply_frames(self._custom.get_out_config(caps))
+        else:
+            cfg = self._declared_out_config(caps)
+        self._out_config = cfg
+        self.set_src_caps(Caps.from_config(cfg))
+
+    def _declared_out_config(self, caps: Caps) -> TensorsConfig:
+        """Pure per-media out-config computation: the piece of
+        negotiation shared by the runtime path and pipelint (custom
+        ``mode`` converters are handled separately)."""
+        s = caps.structures[0]
+        if s.name == "video/x-raw":
             cfg = self._video_config(caps)
         elif s.name == "audio/x-raw":
             cfg = self._audio_config(caps)
@@ -69,14 +78,28 @@ class TensorConverter(TransformElement):
                     f"{self.name}: unsupported media type {s.name!r}")
             self._custom = conv
             cfg = conv.get_out_config(caps)
+        return self._apply_frames(cfg)
+
+    def _apply_frames(self, cfg: TensorsConfig) -> TensorsConfig:
         n = self.frames_per_tensor
         if n > 1 and cfg.info.is_valid():
             for info in cfg.info:
                 info.shape = (n, *info.shape)
             if cfg.rate_n > 0:
                 cfg.rate_d *= n
-        self._out_config = cfg
-        self.set_src_caps(Caps.from_config(cfg))
+        return cfg
+
+    def static_transfer(self, in_caps):
+        """Out config per declared media type (video/audio/text/octet/
+        tensors); custom ``mode`` converters are unknown until runtime."""
+        caps = in_caps.get("sink")
+        if caps is None or caps.any or not caps.structures \
+                or not caps.is_fixed() or self.mode:
+            return {"src": None}
+        cfg = self._declared_out_config(caps)
+        if not len(cfg.info) or not cfg.info.is_valid():
+            return {"src": None}  # dims lock from the first buffer
+        return {"src": Caps.from_config(cfg)}
 
     def _video_config(self, caps: Caps) -> TensorsConfig:
         s = caps.structures[0]

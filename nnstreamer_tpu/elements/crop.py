@@ -61,6 +61,16 @@ class TensorCrop(Element):
         if pad.name == "raw":
             self.forward_event(event)
 
+    def static_transfer(self, in_caps):
+        """Flexible output (per-region crops have data-dependent dims);
+        the rate follows the raw pad."""
+        raw = in_caps.get("raw")
+        if raw is None or not raw.is_fixed():
+            return {"src": None}
+        cfg = raw.to_config()
+        return {"src": Caps.from_config(TensorsConfig(
+            TensorsInfo(), TensorFormat.FLEXIBLE, cfg.rate_n, cfg.rate_d))}
+
     def do_chain(self, pad: Pad, buf: Buffer) -> None:
         with self._lock:
             (self._raw_q if pad.name == "raw" else self._info_q).append(buf)

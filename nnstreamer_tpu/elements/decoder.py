@@ -45,6 +45,19 @@ class TensorDecoder(TransformElement):
         out = self._decoder.get_out_caps(caps.to_config())
         self.set_src_caps(out)
 
+    def static_transfer(self, in_caps):
+        """The mode subplugin's get_out_caps on the declared config
+        (subplugins declare out caps without touching data)."""
+        if not self.mode:
+            raise ValueError(f"{self.name}: 'mode' property is required")
+        caps = in_caps.get("sink")
+        if caps is None or not caps.is_fixed():
+            return {"src": None}
+        dec = find_decoder(self.mode)()
+        dec.set_options(
+            [getattr(self, f"option{i}") for i in range(1, 10)])
+        return {"src": dec.get_out_caps(caps.to_config())}
+
     def transform(self, buf: Buffer) -> Optional[Buffer]:
         out = self._decoder.decode(buf)
         if out is None:

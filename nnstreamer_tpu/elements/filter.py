@@ -26,7 +26,7 @@ from ..tensors.buffer import Buffer, Chunk
 from ..tensors.caps import Caps
 from ..tensors.info import TensorInfo, TensorsConfig, TensorsInfo
 from ..tensors.types import TensorFormat
-from ..pipeline.element import Element
+from ..pipeline.element import Element, TransferError
 from ..pipeline.events import Event, QosEvent
 from ..pipeline.pad import Pad
 from ..pipeline.registry import register_element
@@ -41,6 +41,23 @@ _MAX_RECENT = 10
 # the estimate grows past reported×(1+5%) or improves by more than 25%
 _LATENCY_REPORT_HEADROOM = 1.05
 _LATENCY_IMPROVE_THRESHOLD = 0.75
+
+
+def infer_batch_dim(sel: TensorsInfo, model: TensorsInfo) -> Optional[int]:
+    """The stream's uniform leading batch dim over the model input, or
+    None when the stream is not model-plus-one-leading-dim."""
+    if len(sel) != len(model):
+        return None
+    b = None
+    for s, m in zip(sel, model):
+        if s.type != m.type or len(s.shape) != len(m.shape) + 1 \
+                or tuple(s.shape[1:]) != tuple(m.shape):
+            return None
+        if b is None:
+            b = int(s.shape[0])
+        elif int(s.shape[0]) != b:
+            return None
+    return b
 
 
 @register_element("tensor_filter")
@@ -184,18 +201,9 @@ class TensorFilter(Element):
         the fail-fast caps mismatch error."""
         if not getattr(self.fw, "SUPPORTS_BATCH", False):
             return None
-        if self._in_info is None or len(sel) != len(self._in_info):
+        if self._in_info is None:
             return None
-        b = None
-        for s, m in zip(sel, self._in_info):
-            if s.type != m.type or len(s.shape) != len(m.shape) + 1 \
-                    or tuple(s.shape[1:]) != tuple(m.shape):
-                return None
-            if b is None:
-                b = int(s.shape[0])
-            elif int(s.shape[0]) != b:
-                return None
-        return b
+        return infer_batch_dim(sel, self._in_info)
 
     def on_sink_caps(self, pad: Pad, caps: Caps) -> None:
         self._open_fw()
@@ -250,6 +258,52 @@ class TensorFilter(Element):
                     sel = TensorsInfo(cfg.info[i] for i in self._in_combi)
                 if len(sel):
                     self._warmup_invoke(sel)
+
+    def static_transfer(self, in_caps):
+        """Model I/O from declared properties only (the framework is
+        never opened): input/inputtype are checked against the stream
+        with batch-dim tolerance; invoke-dynamic or output/outputtype
+        give the out caps, otherwise the output is unknown."""
+        incaps = in_caps.get("sink")
+        cfg = None
+        if incaps is not None and not incaps.any and incaps.structures \
+                and incaps.is_fixed():
+            try:
+                cfg = incaps.to_config()
+            except ValueError as exc:
+                raise TransferError(f"{self.name}: {exc}", pad="sink")
+        rate = (cfg.rate_n, cfg.rate_d) if cfg is not None else (0, 1)
+        batch = None
+        if self.input and self.inputtype and cfg is not None \
+                and cfg.format == TensorFormat.STATIC and len(cfg.info):
+            model_in = TensorsInfo.make(self.inputtype, self.input)
+            sel = cfg.info
+            if self.input_combination:
+                idxs = [int(i) for i in self.input_combination.split(",")]
+                sel = TensorsInfo(cfg.info[i] for i in idxs)
+            if len(sel) and not sel.is_equal(model_in):
+                # permissive on batching: SUPPORTS_BATCH is a backend
+                # trait we cannot know without opening the framework
+                batch = infer_batch_dim(sel, model_in)
+                if batch is None:
+                    raise TransferError(
+                        f"{self.name}: model input {model_in!r} does not "
+                        f"match stream caps {sel!r}. Check tensor_"
+                        f"converter/tensor_transform output dims, or the "
+                        f"input/inputtype properties.", pad="sink")
+        if self.invoke_dynamic:
+            out_cfg = TensorsConfig(TensorsInfo(), TensorFormat.FLEXIBLE,
+                                    *rate)
+        elif self.output and self.outputtype:
+            out_info = TensorsInfo.make(self.outputtype, self.output)
+            if batch is not None:
+                out_info = TensorsInfo(
+                    TensorInfo(i.name, i.type, (batch,) + tuple(i.shape))
+                    for i in out_info)
+            out_cfg = TensorsConfig(out_info, TensorFormat.STATIC, *rate)
+        else:
+            return {"src": None}  # model metadata needs the framework
+        return {"src": Caps.from_config(out_cfg)}
 
     def _warmup_invoke(self, sel: TensorsInfo) -> None:
         """One zero-filled invoke with the NEGOTIATED stream shapes

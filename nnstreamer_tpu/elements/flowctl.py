@@ -97,6 +97,32 @@ class TensorIf(Element):
                     cfg.format, cfg.rate_n, cfg.rate_d)
             self.set_src_caps(Caps.from_config(out), pad=p)
 
+    def static_transfer(self, in_caps):
+        """Per-branch config: passthrough, or the TENSORPICK selection;
+        SKIP branches carry nothing."""
+        caps = in_caps.get("sink")
+        cfg = caps.to_config() \
+            if caps is not None and caps.is_fixed() else None
+        out: dict = {}
+        for pname, action, option in (
+                ("src_0", self.get_property("then"), self.then_option),
+                ("src_1", self.get_property("else"), self.else_option)):
+            if pname not in self.src_pads:
+                continue
+            if cfg is None or action == "SKIP":
+                out[pname] = None
+                continue
+            sel = cfg
+            if action == "TENSORPICK" and option:
+                picks = [int(i) for i in option.split(",")]
+                sel = TensorsConfig(
+                    TensorsInfo(cfg.info[i].copy() for i in picks),
+                    cfg.format, cfg.rate_n, cfg.rate_d)
+            out[pname] = Caps.from_config(sel)
+        for pname in self.src_pads:
+            out.setdefault(pname, None)
+        return out
+
     # -- condition --------------------------------------------------------
     def _compared_value(self, buf: Buffer) -> float:
         cv = self.compared_value

@@ -201,6 +201,10 @@ class PngDec(TransformElement):
     def on_sink_caps(self, pad, caps) -> None:
         pass  # frame size unknown until the first buffer decodes
 
+    def static_transfer(self, in_caps):
+        """Unknown output: frame dims come from the decoded file."""
+        return {"src": None}
+
     def transform(self, buf: Buffer) -> Optional[Buffer]:
         import io
 
@@ -229,15 +233,25 @@ class VideoScale(TransformElement):
     SRC_TEMPLATES = {"src": "video/x-raw"}
     PROPS = {"width": 0, "height": 0}
 
-    def on_sink_caps(self, pad, caps) -> None:
+    def _out_caps(self, caps: Caps) -> Caps:
         (h, w, _), fmt = video_frame_shape(caps)
         out_w = self.width or w
         out_h = self.height or h
         s = caps.structures[0]
         rate = s.fields.get("framerate", "0/1")
-        self.set_src_caps(Caps(
+        return Caps(
             f"video/x-raw,format={fmt},width={out_w},height={out_h},"
-            f"framerate={rate}"))
+            f"framerate={rate}")
+
+    def on_sink_caps(self, pad, caps) -> None:
+        self.set_src_caps(self._out_caps(caps))
+
+    def static_transfer(self, in_caps):
+        """Scaled width/height on the declared video caps."""
+        caps = in_caps.get("sink")
+        if caps is None or not caps.is_fixed():
+            return {"src": None}
+        return {"src": self._out_caps(caps)}
 
     def transform(self, buf: Buffer) -> Optional[Buffer]:
         frame = buf.chunks[0].host()
@@ -263,14 +277,24 @@ class VideoConvert(TransformElement):
     SRC_TEMPLATES = {"src": "video/x-raw"}
     PROPS = {"format": ""}
 
-    def on_sink_caps(self, pad, caps) -> None:
+    def _out_caps(self, caps: Caps) -> Caps:
         (h, w, _), fmt = video_frame_shape(caps)
         out_fmt = self.format or fmt
         s = caps.structures[0]
         rate = s.fields.get("framerate", "0/1")
-        self.set_src_caps(Caps(
+        return Caps(
             f"video/x-raw,format={out_fmt},width={w},height={h},"
-            f"framerate={rate}"))
+            f"framerate={rate}")
+
+    def on_sink_caps(self, pad, caps) -> None:
+        self.set_src_caps(self._out_caps(caps))
+
+    def static_transfer(self, in_caps):
+        """Converted colorspace format on the declared video caps."""
+        caps = in_caps.get("sink")
+        if caps is None or not caps.is_fixed():
+            return {"src": None}
+        return {"src": self._out_caps(caps)}
 
     def transform(self, buf: Buffer) -> Optional[Buffer]:
         _, in_fmt = video_frame_shape(self.sinkpad.caps)

@@ -115,6 +115,10 @@ class TensorQueryServerSrc(SrcElement):
     def negotiate_src_caps(self) -> Optional[Caps]:
         return Caps(_FLEX_CAPS)
 
+    def static_src_caps(self) -> Optional[Caps]:
+        """Flexible tensors (shapes arrive per request)."""
+        return Caps(_FLEX_CAPS)
+
     def start(self) -> None:
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -359,6 +363,10 @@ class TensorQueryClient(Element):
         # client falls back to FIFO pairing
         self._seq = 0
         self.stats.update({"reconnects": 0, "shed": 0})
+
+    def static_transfer(self, in_caps):
+        """Unknown output: result caps come from the remote server."""
+        return {"src": None}
 
     def _endpoints(self, timeout: float) -> list:
         """Candidate servers, most preferred first."""

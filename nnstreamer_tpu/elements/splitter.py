@@ -60,6 +60,26 @@ class TensorDemux(Element):
             if p.is_linked:
                 p.push(buf.with_chunks([buf.chunks[i] for i in pick]))
 
+    def static_transfer(self, in_caps):
+        """Per-src-pad pick of the input tensors (pads map to picks by
+        their name index; no pads are created)."""
+        caps = in_caps.get("sink")
+        cfg = caps.to_config() \
+            if caps is not None and caps.is_fixed() else None
+        if cfg is None or not len(cfg.info):
+            return {p: None for p in self.src_pads}
+        picks = self._picks(len(cfg.info))
+        out = {}
+        for pname in self.src_pads:
+            _, _, idx = pname.rpartition("_")
+            if not idx.isdigit() or int(idx) >= len(picks):
+                out[pname] = None
+                continue
+            info = TensorsInfo(cfg.info[i].copy() for i in picks[int(idx)])
+            out[pname] = Caps.from_config(TensorsConfig(
+                info, cfg.format, cfg.rate_n, cfg.rate_d))
+        return out
+
 
 @register_element("tensor_split")
 class TensorSplit(Element):
@@ -119,6 +139,30 @@ class TensorSplit(Element):
                 cfg.format, cfg.rate_n, cfg.rate_d)
             if p.is_linked:
                 self.set_src_caps(Caps.from_config(out), pad=p)
+
+    def static_transfer(self, in_caps):
+        """Per-src-pad slice shapes from ``tensorseg`` (missing or
+        non-tiling segs are provable errors)."""
+        caps = in_caps.get("sink")
+        cfg = caps.to_config() \
+            if caps is not None and caps.is_fixed() else None
+        if cfg is None or not len(cfg.info) or not cfg.info.is_valid():
+            return {p: None for p in self.src_pads}
+        info = cfg.info[0]
+        self._parse_segs(info.shape)  # raises the runtime's ValueError
+        out = {}
+        for pname in self.src_pads:
+            _, _, idx = pname.rpartition("_")
+            if not idx.isdigit() or int(idx) >= len(self._segs):
+                out[pname] = None
+                continue
+            shape = list(info.shape)
+            shape[self._axis] = self._segs[int(idx)][self._axis]
+            out[pname] = Caps.from_config(TensorsConfig(
+                TensorsInfo([TensorInfo(info.name, info.type,
+                                        tuple(shape))]),
+                cfg.format, cfg.rate_n, cfg.rate_d))
+        return out
 
     def do_chain(self, pad: Pad, buf: Buffer) -> None:
         arr = buf.chunks[0].host()

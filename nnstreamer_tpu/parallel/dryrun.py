@@ -13,16 +13,49 @@ initialized on another platform (e.g. the tunneled TPU), callers must run
 from __future__ import annotations
 
 import os
+import re
+import sys
+
+_SUBPROCESS_HINT = (
+    "run the dryrun in a fresh subprocess instead: "
+    "`python -m nnstreamer_tpu.parallel.dryrun <n>` "
+    "(what __graft_entry__.dryrun_multichip does)")
+
+
+def _backend_initialized() -> bool:
+    """True once a JAX backend exists in this process — from then on
+    XLA_FLAGS edits and jax_platforms flips are silent no-ops."""
+    if sys.modules.get("jax") is None:
+        return False
+    try:
+        from jax._src import xla_bridge
+    except ImportError:  # pragma: no cover - very old jax layout
+        return False
+    if hasattr(xla_bridge, "backends_are_initialized"):
+        return bool(xla_bridge.backends_are_initialized())
+    return bool(getattr(xla_bridge, "_backends", None))
 
 
 def ensure_devices(n_devices: int) -> None:
     """Make >= n_devices JAX devices available, or raise.
 
-    Must be called before JAX initializes a backend in this process —
-    afterwards ``jax_platforms`` flips are silent no-ops.
+    Must be called before JAX initializes a backend in this process.
+    Afterwards the device-count flag cannot take effect any more, so
+    instead of silently no-opping (and failing later with a confusing
+    device count) this raises a RuntimeError naming the subprocess
+    fallback.
     """
     flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
+    m = re.search(r"xla_force_host_platform_device_count=(\d+)", flags)
+    forced = int(m.group(1)) if m else 0
+    if forced < n_devices and _backend_initialized():
+        raise RuntimeError(
+            f"ensure_devices({n_devices}): a JAX backend is already "
+            f"initialized in this process with "
+            f"xla_force_host_platform_device_count={forced or 'unset'}, "
+            f"and the flag is a silent no-op after initialization — "
+            + _SUBPROCESS_HINT)
+    if m is None:
         os.environ["XLA_FLAGS"] = (
             flags +
             f" --xla_force_host_platform_device_count={n_devices}").strip()

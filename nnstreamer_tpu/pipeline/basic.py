@@ -11,7 +11,8 @@ from typing import Callable, List, Optional
 from ..tensors.buffer import Buffer, Chunk
 from ..tensors.caps import Caps
 from ..utils.log import logger
-from .element import Element, SinkElement, SrcElement, TransformElement
+from .element import (Element, SinkElement, SrcElement, TransferError,
+                      TransformElement)
 from .events import CapsEvent, EosEvent, Event
 from .pad import FlowError, Pad, PadDirection
 from .registry import register_element
@@ -249,6 +250,16 @@ class CapsFilter(TransformElement):
                 f"{self.name}: caps {incaps} do not satisfy filter {want}")
         return out.fixate() if not out.is_fixed() else out
 
+    def static_transfer(self, in_caps):
+        """Input ∩ ``caps`` property; a fixed caps property alone pins
+        an otherwise-unknown upstream."""
+        if in_caps.get("sink") is None and self.caps:
+            want = Caps(self.caps) if isinstance(self.caps, str) else self.caps
+            if want.is_fixed():
+                return {"src": want}
+            return {"src": None}
+        return super().static_transfer(in_caps)
+
 
 @register_element("identity")
 class Identity(TransformElement):
@@ -347,6 +358,12 @@ class TensorTestSrc(SrcElement):
         self._rng = None
         self._pool = None
         self._uniq = None
+
+    def static_src_caps(self) -> Optional[Caps]:
+        """Fixated ``caps`` property (required for this source)."""
+        if not self.caps:
+            raise TransferError(f"{self.name}: 'caps' property is required")
+        return super().static_src_caps()
 
     def negotiate_src_caps(self) -> Optional[Caps]:
         if not self.caps:

@@ -24,6 +24,16 @@ from .events import (CapsEvent, EosEvent, Event, FlushEvent, QosEvent,
 from .pad import FlowError, Pad, PadDirection
 
 
+class TransferError(ValueError):
+    """A declared caps transfer provably cannot succeed (static analog of
+    a runtime negotiation failure). ``pad`` names the sink pad where the
+    contradiction was detected, when known."""
+
+    def __init__(self, message: str, pad: Optional[str] = None):
+        super().__init__(message)
+        self.pad = pad
+
+
 def _coerce(value: str, default: Any) -> Any:
     """Coerce a launch-string property value to the default's type."""
     if not isinstance(value, str):
@@ -198,6 +208,39 @@ class Element:
         """in caps -> out caps; identity by default (passthrough)."""
         return incaps
 
+    # -- static analysis (pipelint) ---------------------------------------
+    def static_src_caps(self) -> Optional[Caps]:
+        """Declared output caps of a source element, computed WITHOUT
+        starting it. Default: the fixated ``caps`` property when the
+        element declares one; None (unknown) otherwise."""
+        caps_str = getattr(self, "caps", None)
+        if isinstance(caps_str, str) and caps_str:
+            try:
+                return Caps(caps_str).fixate()
+            except ValueError as exc:
+                raise TransferError(
+                    f"{self.name}: bad caps property {caps_str!r}: {exc}")
+        return None
+
+    def static_transfer(
+            self, in_caps: Dict[str, Optional[Caps]],
+    ) -> Dict[str, Optional[Caps]]:
+        """Declared caps transfer: map per-sink-pad input caps to per-src-
+        pad output caps without executing the element. ``None`` marks an
+        unknown (gradual typing) — rules only fire on known caps. Raise
+        :class:`TransferError` for a provable contradiction.
+
+        Default declaration: sources answer :meth:`static_src_caps`,
+        single-sink elements pass their input through to every src pad,
+        and multi-sink elements are unknown (override to say more)."""
+        if not self.sink_pads:
+            caps = self.static_src_caps()
+            return {p: caps for p in self.src_pads}
+        if len(in_caps) == 1:
+            caps = next(iter(in_caps.values()))
+            return {p: caps for p in self.src_pads}
+        return {p: None for p in self.src_pads}
+
     def set_src_caps(self, caps: Caps, pad: Optional[Pad] = None) -> None:
         pads = [pad] if pad is not None else list(self.src_pads.values())
         for p in pads:
@@ -255,6 +298,17 @@ class TransformElement(Element):
 
     def transform(self, buf: Buffer) -> Optional[Buffer]:
         raise NotImplementedError
+
+    def static_transfer(self, in_caps):
+        """Pure ``transform_caps`` on the declared input caps."""
+        incaps = in_caps.get("sink")
+        if incaps is None:
+            return {p: None for p in self.src_pads}
+        out = self.transform_caps(incaps)
+        if out is None:
+            raise TransferError(
+                f"{self.name}: cannot negotiate caps {incaps}", pad="sink")
+        return {p: out for p in self.src_pads}
 
 
 class SrcElement(Element):

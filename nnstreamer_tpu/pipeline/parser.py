@@ -13,6 +13,9 @@ runTest.sh). Supported grammar:
 
 Branching works like gst-launch: ``tee name=t ! q1 ... t. ! q2 ...`` and
 ``src ! m.sink_1`` to target a named pad of a mux.
+
+Every parse error reports the token index and the offending token, so a
+long description can be debugged without counting whitespace by hand.
 """
 from __future__ import annotations
 
@@ -29,14 +32,14 @@ _REF_RE = re.compile(r"^([A-Za-z][\w-]*)\.([\w%-]*)$")
 
 
 def _tokenize(desc: str) -> List[str]:
-    toks, cur, quote = [], [], None
-    for ch in desc:
+    toks, cur, quote, qpos = [], [], None, -1
+    for pos, ch in enumerate(desc):
         if quote:
             cur.append(ch)
             if ch == quote:
                 quote = None
         elif ch in "\"'":
-            quote = ch
+            quote, qpos = ch, pos
             cur.append(ch)
         elif ch.isspace():
             if cur:
@@ -45,7 +48,9 @@ def _tokenize(desc: str) -> List[str]:
         else:
             cur.append(ch)
     if quote:
-        raise ValueError(f"unterminated quote in pipeline description: {desc!r}")
+        raise ValueError(
+            f"unterminated {quote} quote starting at character {qpos} "
+            f"near {desc[max(0, qpos - 15):qpos + 15]!r}")
     if cur:
         toks.append("".join(cur))
     return toks
@@ -84,17 +89,20 @@ def parse_launch(desc: str, pipeline: Optional[Pipeline] = None) -> Pipeline:
     current: Optional[Element] = None
     pending_link = False
 
-    def _rename(elem: Element, new: str) -> None:
+    def _err(i: int, message: str) -> ValueError:
+        return ValueError(f"token {i} ({tokens[i]!r}): {message}")
+
+    def _rename(i: int, elem: Element, new: str) -> None:
         if new in pipe.elements:
-            raise ValueError(f"duplicate element name {new!r}")
+            raise _err(i, f"duplicate element name {new!r}")
         del pipe.elements[elem.name]
         elem.name = new
         pipe.elements[new] = elem
 
-    for tok in tokens:
+    for i, tok in enumerate(tokens):
         if tok == "!":
             if current is None:
-                raise ValueError("'!' with no upstream element")
+                raise _err(i, "'!' with no upstream element")
             pending_link = True
             continue
 
@@ -102,7 +110,7 @@ def parse_launch(desc: str, pipeline: Optional[Pipeline] = None) -> Pipeline:
         if ref and not _is_caps_token(tok):
             name, padname = ref.group(1), ref.group(2) or None
             if name not in pipe.elements:
-                raise ValueError(f"reference to unknown element {name!r}")
+                raise _err(i, f"reference to unknown element {name!r}")
             target = pipe.elements[name]
             if pending_link:
                 _free_src_pad(current).link(_free_sink_pad(target, padname))
@@ -116,9 +124,12 @@ def parse_launch(desc: str, pipeline: Optional[Pipeline] = None) -> Pipeline:
         if m and not _is_caps_token(tok) and not pending_link and current is not None:
             key, val = m.group(1), _unquote(m.group(2))
             if key == "name":
-                _rename(current, val)
+                _rename(i, current, val)
             else:
-                current.set_property(key, val)
+                try:
+                    current.set_property(key, val)
+                except ValueError as exc:
+                    raise _err(i, str(exc)) from None
             continue
 
         # element creation (kind or inline caps)
@@ -126,8 +137,12 @@ def parse_launch(desc: str, pipeline: Optional[Pipeline] = None) -> Pipeline:
             elem = make_element("capsfilter", caps=_unquote(tok))
         else:
             if m:
-                raise ValueError(f"property {tok!r} with no element to apply to")
-            elem = make_element(tok)
+                raise _err(i, f"property {tok!r} with no element to "
+                              f"apply to")
+            try:
+                elem = make_element(tok)
+            except ValueError as exc:
+                raise _err(i, str(exc)) from None
         pipe.add(elem)
         if pending_link:
             _free_src_pad(current).link(_free_sink_pad(elem))
@@ -135,5 +150,6 @@ def parse_launch(desc: str, pipeline: Optional[Pipeline] = None) -> Pipeline:
         current = elem
 
     if pending_link:
-        raise ValueError("dangling '!' at end of description")
+        raise ValueError(
+            f"dangling '!' at end of description (token {len(tokens) - 1})")
     return pipe

@@ -57,6 +57,9 @@ class Pipeline:
         self._lock = threading.Lock()
         self.running = False
         self.tracer = None  # set by enable_tracing()
+        # pre-PLAYING static validation gate (pipelint); set False to
+        # launch a pipeline the analyzer rejects (escape hatch)
+        self.validate_on_start = True
 
     def enable_tracing(self):
         """Attach a Tracer (≙ GstShark proctime/interlatency/framerate
@@ -111,9 +114,27 @@ class Pipeline:
             self.post_message("eos")
             self._eos_evt.set()
 
+    # -- static analysis ---------------------------------------------------
+    def validate(self):
+        """Run pipelint (caps/shape inference + graph rules) over the
+        unstarted graph; returns the :class:`analysis.Report`."""
+        from ..analysis import analyze
+        return analyze(self)
+
     # -- state ------------------------------------------------------------
     def start(self) -> "Pipeline":
-        """READY->PLAYING: start non-sources first, then source threads."""
+        """READY->PLAYING: start non-sources first, then source threads.
+
+        Validates the graph first (``validate_on_start``, default True):
+        error findings raise :class:`PipelineValidationError` before any
+        element starts; warnings are logged."""
+        if self.validate_on_start:
+            from ..analysis import PipelineValidationError
+            report = self.validate()
+            if report.errors:
+                raise PipelineValidationError(report)
+            for f in report.warnings:
+                logger.warning("pipelint: %s", f)
         self._sinks_eos.clear()
         self._eos_evt.clear()
         self._error = None

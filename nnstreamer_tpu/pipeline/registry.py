@@ -42,8 +42,11 @@ def make_element(kind: str, name=None, **props):
     try:
         cls = _ELEMENTS[kind]
     except KeyError:
-        raise ValueError(
-            f"no such element {kind!r}; known: {sorted(_ELEMENTS)}") from None
+        import difflib
+        close = difflib.get_close_matches(kind, _ELEMENTS, n=3, cutoff=0.6)
+        hint = (f"did you mean {', '.join(repr(c) for c in close)}?"
+                if close else f"known: {sorted(_ELEMENTS)}")
+        raise ValueError(f"no such element {kind!r}; {hint}") from None
     return cls(name=name, **props)
 
 

@@ -79,6 +79,11 @@ class TensorServeSrc(SrcElement):
     def negotiate_src_caps(self) -> Optional[Caps]:
         return Caps(_FLEX_CAPS)
 
+    def static_src_caps(self) -> Optional[Caps]:
+        """Flexible tensors (bucketed padded batches, shapes per
+        request); the jit cache sees at most len(buckets) signatures."""
+        return Caps(_FLEX_CAPS)
+
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
         self.scheduler = ServeScheduler(

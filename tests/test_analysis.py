@@ -1,0 +1,294 @@
+"""pipelint: static analyzer over parsed-but-unstarted pipelines.
+
+Seeds one pipeline per defect class and asserts the analyzer reports
+the right rule at the right element/pad with the right severity —
+without ever starting an element. Every intentionally defective
+description below is tagged ``# pipelint: skip`` so the clean-corpus
+gate (tools/lint_corpus.py) does not trip over its own fixtures.
+"""
+import json
+
+import pytest
+
+from nnstreamer_tpu import parse_launch
+from nnstreamer_tpu.analysis import (PipelineValidationError, Report,
+                                     Severity, analyze, infer_caps)
+
+CAPS_U8 = ("other/tensors,format=static,num_tensors=1,"
+           "types=(string)uint8,dimensions=(string)3:4:4,"
+           "framerate=(fraction)0/1")
+CAPS_F32 = ("other/tensors,format=static,num_tensors=1,"
+            "types=(string)float32,dimensions=(string)3:4:4,"
+            "framerate=(fraction)0/1")
+# stream of batched vectors: numpy shape (6, 4) -> batch axis 6
+CAPS_BATCH6 = ("other/tensors,format=static,num_tensors=1,"
+               "types=(string)float32,dimensions=(string)4:6,"
+               "framerate=(fraction)0/1")
+
+
+def findings_for(desc, rule=None):
+    report = analyze(parse_launch(desc))
+    if rule is None:
+        return report.findings
+    return [f for f in report.findings if f.rule == rule]
+
+
+class TestCapsInference:
+    def test_propagates_through_chain(self):
+        p = parse_launch(
+            f"tensortestsrc name=src caps={CAPS_U8} ! "
+            "tensor_transform name=x mode=typecast option=float32 ! "
+            "appsink name=out")
+        res = infer_caps(p)
+        assert not res.findings
+        out = res.out_caps(p["x"])["src"]
+        cfg = out.to_config()
+        assert str(cfg.info[0].type) == "float32"
+
+    def test_capsfilter_contradiction_located(self):
+        bad = (  # pipelint: skip — u8 stream into a sparse-only filter
+            f"tensortestsrc caps={CAPS_U8} ! "
+            "other/tensors,format=sparse name=cf ! fakesink")
+        got = findings_for(bad, "caps-inference")
+        assert len(got) == 1
+        f = got[0]
+        assert f.severity is Severity.ERROR
+        assert f.element == "cf" and f.pad == "sink"
+        assert "do not satisfy" in f.message
+
+    def test_missing_required_caps_prop(self):
+        got = findings_for(  # pipelint: skip — testsrc without caps
+            "tensortestsrc name=src ! fakesink", "caps-inference")
+        assert len(got) == 1
+        assert got[0].severity is Severity.ERROR
+        assert got[0].element == "src"
+        assert "'caps' property is required" in got[0].message
+
+    def test_filter_model_mismatch_located(self):
+        bad = (  # pipelint: skip — declared model wants dim 8, stream has 3:4:4
+            f"tensortestsrc caps={CAPS_F32} ! "
+            "tensor_filter name=f framework=jax model=zoo://mlp "
+            "input=8 inputtype=float32 ! fakesink")
+        got = findings_for(bad, "caps-inference")
+        assert len(got) == 1
+        assert got[0].severity is Severity.ERROR
+        assert got[0].element == "f" and got[0].pad == "sink"
+
+
+class TestRules:
+    def test_dangling_crop_info_pad(self):
+        bad = (  # pipelint: skip — crop's info pad left unlinked
+            f"tensortestsrc caps={CAPS_U8} ! "
+            "tensor_crop name=c ! fakesink")
+        got = findings_for(bad, "dangling-pad")
+        assert [(f.element, f.pad) for f in got] == [("c", "info")]
+        assert got[0].severity is Severity.WARNING
+
+    def test_isolated_element(self):
+        bad = (  # pipelint: skip — mux is not linked to anything
+            f"tensortestsrc caps={CAPS_U8} ! fakesink "
+            "tensor_mux name=lonely")
+        got = findings_for(bad, "dangling-pad")
+        assert [(f.element, f.message) for f in got] == \
+            [("lonely", "element is not linked to anything")]
+
+    def test_cycle_detected_on_both_members(self):
+        bad = (  # pipelint: skip — i1 -> i2 -> i1 dataflow loop
+            "identity name=i1 ! identity name=i2 ! i1.")
+        got = findings_for(bad, "cycle")
+        assert sorted(f.element for f in got) == ["i1", "i2"]
+        assert all(f.severity is Severity.ERROR for f in got)
+        assert "i1 -> i2" in got[0].message
+
+    def test_tee_branch_without_queue(self):
+        bad = (  # pipelint: skip — first tee branch has no queue
+            f"tensortestsrc caps={CAPS_U8} ! tee name=t ! fakesink "
+            "t. ! queue ! fakesink")
+        got = findings_for(bad, "tee-no-queue")
+        assert [(f.element, f.pad) for f in got] == [("t", "src_0")]
+        assert got[0].severity is Severity.WARNING
+
+    def test_jit_signatures_unbounded_upstream(self):
+        bad = (  # pipelint: skip — flexible stream, no batch bound
+            "tensor_query_serversrc name=qs ! "
+            "tensor_filter name=f framework=jax model=zoo://mlp ! "
+            "tensor_query_serversink")
+        got = findings_for(bad, "jit-signatures")
+        assert [(f.element, f.pad) for f in got] == [("f", "sink")]
+        assert got[0].severity is Severity.WARNING
+        assert "unbounded" in got[0].message
+
+    def test_jit_signatures_bounded_by_batching(self):
+        ok = ("tensor_query_serversrc name=qs batch=4 ! "
+              "tensor_filter name=f framework=jax model=zoo://mlp ! "
+              "tensor_query_serversink")
+        assert findings_for(ok, "jit-signatures") == []
+
+    def test_jit_signatures_bucket_budget(self):
+        bad = (  # pipelint: skip — 9 buckets > the signature budget of 8
+            "tensor_serve_src name=s buckets=1,2,3,4,5,6,7,8,9 ! "
+            "tensor_filter name=f framework=jax model=zoo://mlp ! "
+            "tensor_serve_sink")
+        got = findings_for(bad, "jit-signatures")
+        assert [(f.element, f.pad) for f in got] == [("f", "sink")]
+        assert "9 batch buckets" in got[0].message
+
+    def test_sharding_divisibility_provable(self):
+        bad = (  # pipelint: skip — batch 6 on a dp=4 mesh
+            f"tensortestsrc caps={CAPS_BATCH6} ! "
+            "tensor_filter name=f framework=jax model=zoo://mlp "
+            'input=4 inputtype=float32 custom="mesh:4x1x2" ! fakesink')
+        got = findings_for(bad, "sharding-divisibility")
+        assert [(f.element, f.pad) for f in got] == [("f", "sink")]
+        assert got[0].severity is Severity.ERROR
+        assert "batch 6 is not divisible" in got[0].message
+
+    def test_sharding_divisible_is_clean(self):
+        ok = (f"tensortestsrc caps={CAPS_BATCH6} ! "
+              "tensor_filter name=f framework=jax model=zoo://mlp "
+              'input=4 inputtype=float32 custom="mesh:2x1x2" ! fakesink')
+        assert findings_for(ok, "sharding-divisibility") == []
+
+    def test_sinkless_pipeline_and_dead_end(self):
+        bad = (  # pipelint: skip — no sink anywhere, converter dead-ends
+            f"tensortestsrc caps={CAPS_U8} ! tensor_converter name=conv")
+        got = findings_for(bad, "sinkless-branch")
+        assert {f.element for f in got} == {None, "conv"}
+        pipe_level = next(f for f in got if f.element is None)
+        assert "no sink element" in pipe_level.message
+        assert all(f.severity is Severity.WARNING for f in got)
+
+    def test_combiner_dtype_mismatch_located(self):
+        bad = (  # pipelint: skip — uint8 and float32 legs into one merge
+            "tensor_merge name=m mode=linear option=0 ! fakesink "
+            f"tensortestsrc caps={CAPS_U8} ! m.sink_0 "
+            f"tensortestsrc caps={CAPS_F32} ! m.sink_1")
+        got = findings_for(bad, "combiner-dtype")
+        assert [(f.element, f.pad) for f in got] == [("m", "sink_1")]
+        assert got[0].severity is Severity.ERROR
+        assert "float32" in got[0].message and "uint8" in got[0].message
+
+    def test_unbounded_admission(self):
+        bad = (  # pipelint: skip — max-queue=0 turns off admission control
+            "tensor_serve_src name=s max-queue=0 ! "
+            "tensor_filter framework=jax model=zoo://mlp ! "
+            "tensor_serve_sink")
+        got = findings_for(bad, "unbounded-admission")
+        assert [(f.element, f.severity) for f in got] == \
+            [("s", Severity.WARNING)]
+        assert "max-queue=0" in got[0].message
+
+    def test_query_serversrc_admission_is_info_only(self):
+        desc = ("tensor_query_serversrc name=qs batch=4 ! "
+                "tensor_filter framework=jax model=zoo://mlp ! "
+                "tensor_query_serversink")
+        got = findings_for(desc, "unbounded-admission")
+        assert [(f.element, f.severity) for f in got] == \
+            [("qs", Severity.INFO)]
+        report = analyze(parse_launch(desc))
+        assert report.exit_code == 0  # info never fails the gate
+
+
+CLEAN_CORPUS = [
+    # straight filter chain on fixed caps
+    f"tensortestsrc caps={CAPS_U8} num-buffers=2 ! "
+    "tensor_converter ! appsink name=out",
+    # typecast + arithmetic transform chain
+    f"tensortestsrc caps={CAPS_U8} ! "
+    "tensor_transform mode=typecast option=float32 ! "
+    "tensor_transform mode=arithmetic option=mul:2 ! appsink name=out",
+    # tee with a queue on every branch
+    f"tensortestsrc caps={CAPS_U8} ! tee name=t ! queue ! "
+    "appsink name=a t. ! queue ! appsink name=b",
+    # mux joining two equal-dtype legs via named pads
+    "tensor_mux name=m ! appsink name=out "
+    f"tensortestsrc caps={CAPS_U8} ! m.sink_0 "
+    f"tensortestsrc caps={CAPS_U8} ! m.sink_1",
+    # bucketed serving path: bounded signatures, bounded admission
+    "tensor_serve_src name=s buckets=1,2,4 max-queue=16 ! "
+    "tensor_filter framework=jax model=zoo://mlp ! tensor_serve_sink",
+    # demux fan-out with per-branch queues
+    f"tensortestsrc caps={CAPS_U8} ! tensor_demux name=d tensorpick=0 "
+    "d.src_0 ! queue ! appsink name=out",
+]
+
+
+@pytest.mark.parametrize("desc", CLEAN_CORPUS)
+def test_clean_corpus_has_no_errors(desc):
+    report = analyze(parse_launch(desc))
+    assert report.errors == [], report.to_text()
+
+
+class TestStartGate:
+    def test_start_raises_on_error_findings(self):
+        p = parse_launch(  # pipelint: skip — intentional caps mismatch
+            f"tensortestsrc caps={CAPS_U8} ! "
+            "other/tensors,format=sparse ! fakesink")
+        with pytest.raises(PipelineValidationError, match="do not satisfy"):
+            p.start()
+        assert not p.running
+
+    def test_validation_error_names_escape_hatch(self):
+        p = parse_launch(  # pipelint: skip — intentional caps mismatch
+            f"tensortestsrc caps={CAPS_U8} ! "
+            "other/tensors,format=sparse ! fakesink")
+        with pytest.raises(ValueError, match="validate_on_start"):
+            p.start()
+
+    def test_escape_hatch_allows_start(self):
+        p = parse_launch(  # pipelint: skip — intentional caps mismatch
+            f"tensortestsrc caps={CAPS_U8} num-buffers=1 ! "
+            "other/tensors,format=sparse ! fakesink")
+        p.validate_on_start = False
+        p.start()  # static gate skipped; runtime will reject on its own
+        p.stop()
+
+    def test_warnings_do_not_block_start(self):
+        p = parse_launch(  # pipelint: skip — tee branch without queue
+            f"tensortestsrc caps={CAPS_U8} num-buffers=1 ! tee name=t "
+            "! fakesink t. ! queue ! fakesink")
+        assert analyze(p).warnings
+        p.start()
+        p.wait_eos(10)
+        p.stop()
+
+    def test_validate_returns_report(self):
+        p = parse_launch(f"tensortestsrc caps={CAPS_U8} ! appsink name=o")
+        report = p.validate()
+        assert isinstance(report, Report)
+        assert report.exit_code == 0
+
+
+class TestReport:
+    def test_json_round_trip(self):
+        p = parse_launch(  # pipelint: skip — tee branch without queue
+            f"tensortestsrc caps={CAPS_U8} ! tee name=t ! fakesink "
+            "t. ! queue ! fakesink")
+        report = analyze(p)
+        data = json.loads(report.to_json())
+        assert data["exit_code"] == 1
+        rules = {f["rule"] for f in data["findings"]}
+        assert "tee-no-queue" in rules
+        by_loc = {f["location"]: f for f in data["findings"]}
+        assert by_loc["t.src_0"]["severity"] == "warning"
+
+    def test_text_orders_errors_first(self):
+        p = parse_launch(  # pipelint: skip — cycle + missing queue
+            f"tensortestsrc caps={CAPS_U8} ! tee name=t ! fakesink "
+            "t. ! queue ! fakesink "
+            "identity name=i1 ! identity name=i2 ! i1.")
+        text = analyze(p).to_text()
+        assert text.index("error") < text.index("warning")
+
+    def test_rule_crash_does_not_block(self):
+        from nnstreamer_tpu.analysis.rules import Rule
+
+        class Broken(Rule):
+            id = "broken"
+
+            def check(self, ctx):
+                raise RuntimeError("boom")
+
+        p = parse_launch(f"tensortestsrc caps={CAPS_U8} ! appsink name=o")
+        report = analyze(p, rules=[Broken()])
+        assert report.findings == []

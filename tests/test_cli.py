@@ -62,3 +62,49 @@ def test_launch_error_exit_code():
         "! tensor_filter framework=custom-easy model=missing ! fakesink",
         timeout=180)
     assert r.returncode != 0
+
+
+LINT_CAPS = ('"other/tensors,format=static,num_tensors=1,'
+             'types=(string)uint8,dimensions=(string)3:4:4,'
+             'framerate=(fraction)0/1"')
+
+
+def test_lint_clean_exit_0():
+    r = run_cli("lint", f"tensortestsrc caps={LINT_CAPS} "
+                "! tensor_converter ! appsink name=out")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 error(s), 0 warning(s)" in r.stdout
+
+
+def test_lint_warnings_exit_1():
+    r = run_cli(  # pipelint: skip — tee branch without a queue
+        "lint", f"tensortestsrc caps={LINT_CAPS} ! tee name=t "
+        "! fakesink t. ! queue ! fakesink")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "tee-no-queue" in r.stdout
+    assert "t.src_0" in r.stdout
+
+
+def test_lint_errors_exit_2():
+    r = run_cli(  # pipelint: skip — intentional caps contradiction
+        "lint", f"tensortestsrc caps={LINT_CAPS} "
+        "! other/tensors,format=sparse ! fakesink")
+    assert r.returncode == 2, r.stdout + r.stderr
+    assert "caps-inference" in r.stdout
+
+
+def test_lint_parse_failure_exit_2():
+    r = run_cli("lint", "tensortestsrc caps=x !")
+    assert r.returncode == 2
+    assert "dangling '!'" in r.stdout
+
+
+def test_lint_json_output():
+    r = run_cli(  # pipelint: skip — tee branch without a queue
+        "lint", "--json", f"tensortestsrc caps={LINT_CAPS} ! tee name=t "
+        "! fakesink t. ! queue ! fakesink")
+    assert r.returncode == 1
+    data = json.loads(r.stdout)
+    assert data["exit_code"] == 1
+    assert any(f["rule"] == "tee-no-queue" and f["location"] == "t.src_0"
+               for f in data["findings"])

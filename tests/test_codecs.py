@@ -92,7 +92,7 @@ class TestCodecPipelines:
     def test_decoder_converter_round_trip(self, mode, mime):
         """tensors -> codec bytes -> tensors, mirroring the reference's
         nnstreamer_flatbuf/_protobuf SSAT round-trip pipelines."""
-        p = nt.parse_launch(
+        p = nt.parse_launch(  # pipelint: skip — mode is parametrized
             f'tensortestsrc caps="{CAPS}" num-buffers=3 pattern=random '
             f"seed=7 ! tee name=t "
             f"t. ! appsink name=ref "

@@ -249,7 +249,7 @@ def test_custom_decoder_registration():
     register_custom_decoder("flipper", flip,
                             "other/tensors,format=flexible")
     try:
-        pipe = parse_launch(
+        pipe = parse_launch(  # pipelint: skip — decoder registered at runtime
             'tensortestsrc pattern=counter num-buffers=1 caps="other/tensors,'
             'format=static,num_tensors=1,types=(string)float32,'
             'dimensions=(string)4" ! tensor_decoder mode=flipper '

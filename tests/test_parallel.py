@@ -182,3 +182,45 @@ def test_sharded_forward_ulysses_matches_single_device():
     out = jax.jit(lambda p, t: tfm.forward(p, t, cfg2))(sparams, tokens)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_ensure_devices_satisfied_in_process():
+    """conftest forces 8 virtual CPU devices, so asking for <= 8 is fine
+    even though the backend is long since initialized."""
+    from nnstreamer_tpu.parallel.dryrun import ensure_devices
+    jax.devices()  # make sure a backend exists
+    ensure_devices(8)  # must not raise
+
+
+def test_ensure_devices_refuses_after_backend_init():
+    """Asking for more devices than the already-initialized backend can
+    provide must fail loudly, naming the subprocess fallback — not
+    silently no-op and then report a confusing device count."""
+    from nnstreamer_tpu.parallel.dryrun import ensure_devices
+    jax.devices()
+    with pytest.raises(RuntimeError, match="fresh subprocess"):
+        ensure_devices(64)
+
+
+def test_ensure_devices_refuses_in_clean_process():
+    """End-to-end: a process that initialized JAX *without* the
+    device-count flag gets the explicit error from ensure_devices."""
+    import os
+    import subprocess
+    import sys
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    code = (
+        "import jax; jax.devices()\n"
+        "from nnstreamer_tpu.parallel.dryrun import ensure_devices\n"
+        "try:\n"
+        "    ensure_devices(8)\n"
+        "except RuntimeError as exc:\n"
+        "    assert 'dryrun' in str(exc) and 'subprocess' in str(exc), exc\n"
+        "    print('REFUSED')\n"
+        "else:\n"
+        "    raise SystemExit('ensure_devices silently no-opped')\n")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "REFUSED" in out.stdout

@@ -165,9 +165,10 @@ class TestCapsNegotiation:
         assert len(p["out"].buffers) == 1
 
     def test_capsfilter_reject(self):
-        p = parse_launch(
+        p = parse_launch(  # pipelint: skip — intentional caps mismatch
             f"tensortestsrc caps={CAPS_U8} num-buffers=1 ! "
             "other/tensors,format=sparse ! appsink name=out")
+        p.validate_on_start = False  # exercise the runtime rejection path
         p.start()
         with pytest.raises(ValueError, match="do not satisfy"):
             p.wait_eos(5)
@@ -186,3 +187,82 @@ def test_core_elements_registered():
     for n in ["queue", "tee", "capsfilter", "identity", "appsrc", "appsink",
               "fakesink", "tensortestsrc"]:
         assert n in names
+
+
+class TestParserDiagnostics:
+    """Every parse error names the token index and the offending token."""
+
+    def test_unterminated_quote_reports_position(self):
+        with pytest.raises(ValueError, match=r"unterminated \" quote "
+                                             r"starting at character \d+"):
+            parse_launch('appsrc caps="other/tensors,format=static')
+
+    def test_unterminated_single_quote(self):
+        with pytest.raises(ValueError, match=r"unterminated ' quote"):
+            parse_launch("appsrc caps='oops")
+
+    def test_bad_property_names_token(self):
+        with pytest.raises(ValueError, match=r"token 1 \('nope=1'\)"):
+            parse_launch("tensortestsrc nope=1")
+
+    def test_unknown_element_names_token_and_suggests(self):
+        with pytest.raises(ValueError) as ei:
+            parse_launch("tensor_filtr")
+        msg = str(ei.value)
+        assert "token 0 ('tensor_filtr')" in msg
+        assert "did you mean" in msg and "tensor_filter" in msg
+
+    def test_duplicate_name_names_token(self):
+        with pytest.raises(ValueError, match=r"token 4 .*duplicate "
+                                             r"element name 'q'"):
+            parse_launch("queue name=q ! queue name=q")
+
+    def test_bang_with_no_upstream(self):
+        with pytest.raises(ValueError, match=r"token 0 .*no upstream"):
+            parse_launch("! fakesink")
+
+    def test_dangling_bang(self):
+        with pytest.raises(ValueError, match=r"dangling '!' at end"):
+            parse_launch("fakesink !")
+
+    def test_property_with_no_element(self):
+        with pytest.raises(ValueError, match=r"token 0 .*no element"):
+            parse_launch("nope=1")
+
+    def test_unknown_reference_names_token(self):
+        with pytest.raises(ValueError, match=r"token 1 .*unknown "
+                                             r"element 'ghost'"):
+            parse_launch("fakesink ghost. ! queue")
+
+
+class TestParserBranching:
+    def test_tee_rereference_adds_branch(self):
+        p = parse_launch(
+            f"tensortestsrc caps={CAPS_U8} num-buffers=1 ! tee name=t "
+            "! queue name=q1 ! fakesink t. ! queue name=q2 ! fakesink")
+        t = p["t"]
+        assert set(t.src_pads) == {"src_0", "src_1"}
+        assert t.src_pads["src_0"].peer.element.name == "q1"
+        assert t.src_pads["src_1"].peer.element.name == "q2"
+
+    def test_named_pad_targets_specific_leg(self):
+        p = parse_launch(
+            "tensor_mux name=m ! appsink name=out "
+            f"tensortestsrc name=s1 caps={CAPS_U8} ! m.sink_1")
+        assert p["m"].sink_pads["sink_1"].peer.element.name == "s1"
+
+    def test_inline_caps_becomes_capsfilter(self):
+        from nnstreamer_tpu.pipeline.basic import CapsFilter
+        p = parse_launch(
+            f"tensortestsrc caps={CAPS_U8} num-buffers=1 ! "
+            "other/tensors,format=static name=cf ! appsink name=out")
+        cf = p["cf"]
+        assert isinstance(cf, CapsFilter)
+        assert "format=static" in cf.caps
+
+
+def test_registry_suggests_close_matches():
+    with pytest.raises(ValueError, match=r"did you mean.*tensor_mux"):
+        make_element("tensor_muxx")
+    with pytest.raises(ValueError, match=r"known:"):
+        make_element("zzqqxx")

@@ -15,6 +15,37 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
+def _transfer_doc(cls) -> str:
+    """One line describing the element's declared static caps transfer —
+    what pipelint's inference engine uses to propagate caps without
+    starting the element."""
+    from nnstreamer_tpu.pipeline.element import Element, TransformElement
+
+    def _first_line(func):
+        doc = (func.__doc__ or "").strip()
+        return " ".join(doc.split("\n\n")[0].split()) if doc else ""
+
+    src_caps = next((k.__dict__["static_src_caps"] for k in cls.__mro__
+                     if "static_src_caps" in k.__dict__), None)
+    transfer = next((k.__dict__["static_transfer"] for k in cls.__mro__
+                     if "static_transfer" in k.__dict__), None)
+    if transfer is Element.__dict__["static_transfer"]:
+        if not (getattr(cls, "SINK_TEMPLATES", {}) or {}):
+            # pure source: output is whatever static_src_caps declares
+            if src_caps is not Element.__dict__["static_src_caps"]:
+                return (_first_line(src_caps)
+                        or "source caps from an override of "
+                           "`static_src_caps`")
+            return ("source caps from the `caps` property when set, "
+                    "else unknown")
+        return "identity passthrough (base declaration)"
+    if transfer is TransformElement.__dict__.get("static_transfer"):
+        return ("pure `transform_caps` on the fixated upstream caps; a "
+                "None result is a provable negotiation failure")
+    return (_first_line(transfer)
+            or "element-specific (see `static_transfer` override)")
+
+
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import nnstreamer_tpu  # noqa: F401 — registers all elements
@@ -40,6 +71,8 @@ def main() -> int:
         if doc:
             out.append(doc)
             out.append("")
+        out.append(f"**Caps transfer (pipelint):** {_transfer_doc(cls)}")
+        out.append("")
         props = {}
         for klass in reversed(cls.__mro__):
             props.update(getattr(klass, "PROPS", {}))

@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""Run pipelint over every pipeline description in the repo's corpus.
+
+Extracts candidate gst-launch-style descriptions from
+
+  * string literals in ``tests/*.py`` (f-strings have their ``{...}``
+    holes substituted with ``1`` so ports/paths still tokenize), and
+  * fenced code blocks in ``README.md`` (python blocks via ast, shell
+    blocks via a quoted-string regex),
+
+then statically analyzes each one with :mod:`nnstreamer_tpu.analysis`.
+Exit status is nonzero iff any description produces a severity=error
+finding. Strings that do not parse as pipelines are skipped (counted) —
+most literals in tests are not pipelines at all.
+
+A string literal whose own line (or the line above it) carries a
+``# pipelint: skip`` comment is excluded; that is how intentionally
+defective fixtures (e.g. the seeded-defect corpus in
+tests/test_analysis.py) opt out of the clean-corpus gate.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import re
+import sys
+from pathlib import Path
+from typing import Iterator, List, Tuple
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+_SKIP_RE = re.compile(r"#\s*pipelint:\s*skip")
+# shell-ish quoted string that looks like a pipeline description
+_SH_STR_RE = re.compile(r"\"((?:[^\"\\]|\\.)*)\"|'((?:[^'\\]|\\.)*)'", re.S)
+
+
+def _literal_text(node: ast.AST, env: dict) -> str | None:
+    """The string value of a Constant-str or JoinedStr node.
+
+    Formatted holes are resolved from ``env`` (module-level string
+    constants like ``CAPS``) when possible; an unresolvable hole that
+    fills a caps value gets real (flexible) caps so the substitution
+    doesn't fabricate a caps error, and any other hole (port, path,
+    count) gets ``1``."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for piece in node.values:
+            if isinstance(piece, ast.Constant):
+                parts.append(str(piece.value))
+                continue
+            expr = piece.value if isinstance(piece, ast.FormattedValue) \
+                else piece
+            if isinstance(expr, ast.Name) and expr.id in env:
+                parts.append(env[expr.id])
+            elif re.search(r"caps=[\"']?$", "".join(parts)):
+                parts.append("other/tensors,format=flexible,"
+                             "framerate=(fraction)0/1")
+            else:
+                parts.append("1")
+        return "".join(parts)
+    return None
+
+
+def _skipped(lines: List[str], node: ast.AST) -> bool:
+    """True if ``# pipelint: skip`` appears on the line above the string
+    or anywhere in the lines it spans."""
+    last = getattr(node, "end_lineno", node.lineno) or node.lineno
+    for ln in range(node.lineno - 2, last):
+        if 0 <= ln < len(lines) and _SKIP_RE.search(lines[ln]):
+            return True
+    return False
+
+
+def _from_python(source: str, label: str) -> Iterator[Tuple[str, str]]:
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return
+    lines = source.splitlines()
+    env = {}  # module-level NAME = "literal" bindings, for f-string holes
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name) \
+                and isinstance(stmt.value, ast.Constant) \
+                and isinstance(stmt.value.value, str):
+            env[stmt.targets[0].id] = stmt.value.value
+    inner = {id(piece) for node in ast.walk(tree)
+             if isinstance(node, ast.JoinedStr) for piece in node.values}
+    for node in ast.walk(tree):
+        if id(node) in inner:  # fragment of an f-string, not a string
+            continue
+        text = _literal_text(node, env)
+        if text is None or " ! " not in text:
+            continue
+        if _skipped(lines, node):
+            continue
+        yield f"{label}:{node.lineno}", " ".join(text.split())
+
+
+def _from_markdown(source: str, label: str) -> Iterator[Tuple[str, str]]:
+    block: List[str] = []
+    fence = None
+    lineno = 0
+    for n, line in enumerate(source.splitlines(), 1):
+        if fence is None:
+            if line.lstrip().startswith("```"):
+                fence, block, lineno = line.lstrip()[3:].strip(), [], n
+            continue
+        if line.lstrip().startswith("```"):
+            body = "\n".join(block)
+            found = list(_from_python(body, f"{label}:{lineno}"))
+            if found:
+                yield from found
+            else:  # shell-style block: pull quoted pipeline strings
+                body = body.replace("\\\n", " ")  # join continuations
+                for m in _SH_STR_RE.finditer(body):
+                    text = m.group(1) or m.group(2) or ""
+                    if " ! " in text:
+                        yield (f"{label}:{lineno}", " ".join(text.split()))
+            fence = None
+            continue
+        block.append(line)
+
+
+def collect(paths: List[Path]) -> List[Tuple[str, str]]:
+    out: List[Tuple[str, str]] = []
+    for path in paths:
+        label = str(path.relative_to(ROOT))
+        text = path.read_text(encoding="utf-8")
+        if path.suffix == ".py":
+            out.extend(_from_python(text, label))
+        else:
+            out.extend(_from_markdown(text, label))
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", help="files to scan (default: "
+                    "tests/*.py and README.md)")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="print every linted description")
+    opts = ap.parse_args(argv)
+
+    paths = ([Path(p) for p in opts.paths] if opts.paths else
+             sorted(ROOT.glob("tests/*.py")) + [ROOT / "README.md"])
+
+    from nnstreamer_tpu.analysis import Severity, analyze
+    from nnstreamer_tpu.pipeline.parser import parse_launch
+
+    candidates = collect(paths)
+    linted = skipped = warned = 0
+    failures: List[str] = []
+    for where, desc in candidates:
+        try:
+            pipe = parse_launch(desc)
+        except ValueError:
+            skipped += 1  # extracted literal is not a real pipeline
+            continue
+        report = analyze(pipe)
+        linted += 1
+        if opts.verbose:
+            print(f"-- {where}: {desc}")
+        for f in report.findings:
+            if f.severity >= Severity.ERROR:
+                failures.append(f"{where}: {f}\n    {desc}")
+            elif f.severity >= Severity.WARNING:
+                warned += 1
+                if opts.verbose:
+                    print(f"   {f}")
+    for line in failures:
+        print(line)
+    print(f"pipelint corpus: {linted} descriptions linted, "
+          f"{skipped} non-pipeline strings skipped, {warned} warnings, "
+          f"{len(failures)} errors")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
