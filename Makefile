@@ -9,7 +9,7 @@ SHELL := /bin/bash
 LIB := $(BUILD)/libnnstpu.so
 EXAMPLES := $(BUILD)/custom_passthrough.so $(BUILD)/custom_scaler.so
 
-.PHONY: native clean test check tier1 lint package
+.PHONY: native clean test check tier1 lint chaos package
 
 native: $(LIB) $(EXAMPLES)
 
@@ -20,6 +20,13 @@ native: $(LIB) $(EXAMPLES)
 check: native lint
 	python -m pytest tests/ -q -m 'not slow'
 	python -c "import nnstreamer_tpu as nt; print('import ok:', len(nt.pipeline.registry.element_names()), 'elements')"
+	$(MAKE) chaos
+
+# `make chaos` = the full fault-injection harness including the slow
+# seeded serve-pipeline schedules (excluded from tier-1 by the slow
+# marker; run on demand and at the end of `make check`).
+chaos:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q
 
 # `make tier1` = the exact ROADMAP.md tier-1 verify gate, verbatim
 # (timeout, log tee, pass-dot count and all).

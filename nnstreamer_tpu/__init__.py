@@ -17,9 +17,13 @@ from . import elements  # noqa: F401  (registers tensor_* elements)
 from . import filters  # noqa: F401  (registers filter backends)
 from .filters import register_custom_easy
 from .single import SingleShot
+from .fault import (CircuitBreaker, ErrorPolicy, FaultInjected,
+                    TransientError, register_fatal, register_transient)
 
 __all__ = [
     "Buffer", "Chunk", "Caps", "TensorInfo", "TensorsInfo", "TensorsConfig",
     "TensorType", "TensorFormat", "Pipeline", "parse_launch", "make_element",
     "register_element", "register_custom_easy", "SingleShot", "__version__",
+    "CircuitBreaker", "ErrorPolicy", "FaultInjected", "TransientError",
+    "register_fatal", "register_transient",
 ]

@@ -354,10 +354,64 @@ class UnboundedAdmissionRule(Rule):
                 e.name, severity=Severity.INFO)
 
 
+class LinkResilienceRule(Rule):
+    """Network-edge elements with no timeout or with reconnection
+    disabled turn a transient peer outage into a permanent hang or a
+    silent EOS."""
+
+    id = "link-resilience"
+    severity = Severity.WARNING
+
+    def check(self, ctx: LintContext):
+        for e in ctx.of_kind("tensor_query_client", "edgesrc", "mqttsrc"):
+            if float(getattr(e, "timeout", 0.0)) <= 0:
+                yield self.finding(
+                    "timeout<=0 on a network element: a dead peer hangs "
+                    "the stream forever", e.name)
+            if kind_of(e) in ("edgesrc", "mqttsrc") \
+                    and not bool(getattr(e, "reconnect", True)):
+                yield self.finding(
+                    "reconnect=false: a dropped link ends the stream as "
+                    "EOS instead of re-dialing with backoff", e.name,
+                    severity=Severity.INFO)
+
+
+class ErrorPolicyRule(Rule):
+    """on-error specs are parsed lazily at the first fault — a typo'd
+    spec or an impossible policy (restart of a stateful element) must
+    surface at lint time, not mid-incident."""
+
+    id = "error-policy"
+    severity = Severity.WARNING
+
+    def check(self, ctx: LintContext):
+        from ..fault.policy import ErrorPolicy
+        for e in ctx.elements:
+            spec = str(getattr(e, "on_error", "fail"))
+            try:
+                policy = ErrorPolicy.parse(spec)
+            except ValueError as exc:
+                yield self.finding(
+                    f"unparseable on-error spec {spec!r}: {exc}",
+                    e.name, severity=Severity.ERROR)
+                continue
+            if policy.action == "retry" and isinstance(e, SinkElement):
+                yield self.finding(
+                    "on-error=retry on a sink re-runs side effects "
+                    "(duplicate renders/publishes); prefer skip or fail",
+                    e.name)
+            elif policy.action == "restart" \
+                    and not getattr(type(e), "RESTART_SAFE", False):
+                yield self.finding(
+                    f"on-error=restart on {kind_of(e)}: element is not "
+                    f"restart-safe (a restart discards internal state)",
+                    e.name, severity=Severity.ERROR)
+
+
 ALL_RULES: List[Rule] = [
     DanglingPadRule(), CycleRule(), TeeNoQueueRule(), JitSignatureRule(),
     ShardingRule(), SinklessBranchRule(), CombinerDtypeRule(),
-    UnboundedAdmissionRule(),
+    UnboundedAdmissionRule(), LinkResilienceRule(), ErrorPolicyRule(),
 ]
 
 

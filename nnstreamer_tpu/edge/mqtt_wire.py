@@ -23,6 +23,8 @@ import threading
 import time
 from typing import List, Optional, Tuple
 
+from ..utils.log import logger
+
 # -- packet types (MQTT 3.1.1 §2.2.1) -----------------------------------------
 CONNECT = 0x1
 CONNACK = 0x2
@@ -279,7 +281,11 @@ class MqttClient:
             if ptype != CONNACK or len(body) < 2 or body[1] != 0:
                 raise ConnectionError(
                     f"mqtt: connect refused (type={ptype}, body={body!r})")
-        except Exception:
+        except Exception as exc:
+            # the cause must reach the log even when a caller's retry
+            # loop swallows the re-raise (satellite: no silent failures)
+            logger.warning("mqtt: connect to %s:%s as %r failed: %r",
+                           host, port, client_id, exc)
             self._sock.close()
             raise
 

@@ -23,6 +23,7 @@ from ..tensors.info import TensorInfo, TensorsConfig, TensorsInfo
 class TensorAggregator(TransformElement):
     PROPS = {"frames-in": 1, "frames-out": 1, "frames-flush": 0,
              "frames-dim": 3, "concat": True, "silent": True}
+    RESTART_SAFE = False  # a restart would drop the aggregation window
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)

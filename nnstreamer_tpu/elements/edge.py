@@ -131,17 +131,27 @@ class EdgeSink(SinkElement):
 
 @register_element("edgesrc")
 class EdgeSrc(SrcElement):
+    # reconnect=true: a dropped publisher link is re-dialed with
+    # exponential backoff + jitter inside the timeout window instead of
+    # ending the stream as EOS (set false to keep the old die-on-drop
+    # behavior — e.g. when a supervisor owns restarts)
     PROPS = {"dest-host": "localhost", "dest-port": 3000, "topic": "",
-             "connect-type": "TCP", "timeout": 10.0}
+             "connect-type": "TCP", "timeout": 10.0, "reconnect": True}
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self._sock: Optional[socket.socket] = None
+        self.stats.update({"reconnects": 0, "link_errors": 0})
 
-    def negotiate_src_caps(self) -> Optional[Caps]:
+    def _subscribe(self) -> Caps:
+        """Connect + SUBSCRIBE handshake (the one dial site: first
+        connect and every reconnect share it), backed off with jitter
+        inside the timeout budget."""
+        from ..fault.backoff import Backoff
         deadline = time.monotonic() + self.timeout
+        backoff = Backoff(base=0.05, multiplier=2.0, max_s=1.0)
         last_err = None
-        while time.monotonic() < deadline:
+        while time.monotonic() < deadline and not self._stop_evt.is_set():
             try:
                 self._sock = socket.create_connection(
                     (self.dest_host, int(self.dest_port)),
@@ -149,7 +159,7 @@ class EdgeSrc(SrcElement):
                 break
             except OSError as e:
                 last_err = e
-                time.sleep(0.05)
+                backoff.sleep(self._stop_evt)
         else:
             raise ConnectionError(
                 f"{self.name}: cannot reach edgesink at "
@@ -161,17 +171,43 @@ class EdgeSrc(SrcElement):
         caps_str = meta.get("caps") or "other/tensors,format=flexible"
         return Caps(caps_str)
 
-    def create(self) -> Optional[Buffer]:
+    def negotiate_src_caps(self) -> Optional[Caps]:
+        return self._subscribe()
+
+    def _reconnect(self) -> bool:
+        """Re-dial after a dropped link; True when resubscribed."""
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
         try:
-            while not self._stop_evt.is_set():
+            self._subscribe()
+        except (ConnectionError, OSError) as exc:
+            logger.warning("%s: reconnect failed: %s", self.name, exc)
+            return False
+        self.stats["reconnects"] += 1
+        self.post_message("warning", reconnects=self.stats["reconnects"],
+                          detail="publisher link re-established")
+        return True
+
+    def create(self) -> Optional[Buffer]:
+        while not self._stop_evt.is_set():
+            try:
                 kind, meta, payloads = recv_msg(self._sock)
-                if kind == MsgKind.DATA:
-                    return wire_to_buffer(meta, payloads)
-                if kind == MsgKind.EOS:
+            except (ConnectionError, OSError) as exc:
+                if self._stop_evt.is_set():
                     return None
-        except (ConnectionError, OSError):
-            if not self._stop_evt.is_set():
-                logger.info("%s: publisher closed", self.name)
+                self.stats["link_errors"] += 1
+                logger.info("%s: publisher link lost (%r)", self.name, exc)
+                if self.reconnect and self._reconnect():
+                    continue
+                return None
+            if kind == MsgKind.DATA:
+                return wire_to_buffer(meta, payloads)
+            if kind == MsgKind.EOS:
+                return None
         return None
 
     def stop(self) -> None:

@@ -85,6 +85,15 @@ class TensorFilter(Element):
         # latency per frame. Off by default: chained device-resident
         # elements should NOT force transfers.
         "prefetch-host": False,
+        # circuit breaker on the backend path (fault/breaker.py):
+        # breaker-threshold consecutive invoke failures open it — frames
+        # are then SHED (serve rows answered with MsgKind.SHED +
+        # retry-after, upstream throttled via QosEvent) instead of each
+        # paying a doomed invoke; after breaker-reset-ms one probe
+        # half-opens it. 0 = disabled (default).
+        "breaker-threshold": 0,
+        "breaker-reset-ms": 1000.0,
+        "breaker-retry-after-ms": 50.0,
         # run one zero-filled invoke at caps negotiation so the XLA
         # compile (tens of seconds for a big model) happens before the
         # first real frame instead of stalling it (no reference analog:
@@ -114,8 +123,10 @@ class TensorFilter(Element):
         self._reported_latency_us: Optional[float] = None
         self._throttle_period_ns = 0       # from downstream QoS events
         self._next_accept_ts: Optional[int] = None
+        self._breaker = None
         self.stats.update({"invoke_errors": 0, "frames_dropped": 0,
-                           "qos_dropped": 0})
+                           "qos_dropped": 0, "shed": 0,
+                           "breaker_opened": 0})
 
     # -- framework lifecycle ---------------------------------------------
     def _open_fw(self) -> None:
@@ -171,10 +182,20 @@ class TensorFilter(Element):
         if self._out_combi is None and self.output_combination:
             self._out_combi = [t.strip() for t in self.output_combination.split(",")]
 
+    RESTART_SAFE = True  # stop/start re-opens the framework cleanly
+
     def start(self) -> None:
         super().start()
         self._open_fw()
         self._start_time = time.monotonic()
+        if int(self.breaker_threshold) > 0:
+            from ..fault.breaker import CircuitBreaker
+            self._breaker = CircuitBreaker(
+                threshold=int(self.breaker_threshold),
+                reset_s=float(self.breaker_reset_ms) / 1e3,
+                name=self.name, on_transition=self._on_breaker_transition)
+        else:
+            self._breaker = None
 
     def stop(self) -> None:
         super().stop()
@@ -332,6 +353,12 @@ class TensorFilter(Element):
             # tensor_filter.c:532-584)
             self.stats["qos_dropped"] += 1
             return
+        if self._breaker is not None and not self._breaker.allow():
+            # breaker OPEN: the backend is currently only producing
+            # errors — shed without invoking (TF-Serving-style fail
+            # fast) and tell upstream/clients when to come back
+            self._shed_frame(buf)
+            return
         inputs = [c.raw for c in buf.chunks]
         if self._in_combi:
             inputs = [inputs[i] for i in self._in_combi]
@@ -348,7 +375,10 @@ class TensorFilter(Element):
                 return
             outputs = self.fw.invoke(inputs)
         except InvokeDrop:
-            # subplugin-signaled drop (≙ invoke result > 0): silent
+            # subplugin-signaled drop (≙ invoke result > 0): silent.
+            # A deliberate drop is a WORKING backend for the breaker.
+            if self._breaker is not None:
+                self._breaker.record_success()
             self.stats["frames_dropped"] += 1
             return
         except Exception as exc:  # noqa: BLE001
@@ -361,6 +391,8 @@ class TensorFilter(Element):
             # would pin the traceback (and the input tensors) in memory.
             n = self.stats["invoke_errors"] = self.stats["invoke_errors"] + 1
             self.stats["frames_dropped"] += 1
+            if self._breaker is not None:
+                self._breaker.record_failure()
             logger.warning("%s: invoke failed (frame dropped, pipeline "
                            "kept): %s", self.name, exc)
             if n & (n - 1) == 0 or n % 64 == 0:
@@ -371,6 +403,8 @@ class TensorFilter(Element):
                                          "negotiated caps, or the "
                                          "subplugin's own logs")
             return
+        if self._breaker is not None:
+            self._breaker.record_success()
         self._record_latency(time.perf_counter_ns() - t0)
         if self._watchdog is not None:
             self._watchdog.feed()
@@ -399,6 +433,38 @@ class TensorFilter(Element):
             outputs = submit_fetch(outputs)
         out_chunks = self._combine_outputs(buf, outputs)
         self.push(buf.with_chunks(out_chunks))
+
+    # -- circuit breaker ---------------------------------------------------
+    def _shed_frame(self, buf: Buffer) -> None:
+        """Answer a frame while the breaker is open: serve-batch rows
+        get their on_shed callback (the wire-level SHED + retry-after
+        reply), and upstream gets a QosEvent spaced by the retry-after
+        hint so sources stop producing doomed frames."""
+        self.stats["shed"] += 1
+        self.stats["dropped"] += 1
+        retry_after_ms = float(self.breaker_retry_after_ms)
+        rows = buf.extras.get("serve_rows")
+        if rows:
+            for req in rows:
+                if req.on_shed is not None:
+                    try:
+                        req.on_shed(req)
+                    except Exception:  # noqa: BLE001 — one dead client
+                        logger.warning("%s: shed callback failed for "
+                                       "stream %s", self.name,
+                                       req.stream_id, exc_info=True)
+        self.send_upstream_event(QosEvent(
+            proportion=2.0, period_ns=int(retry_after_ms * 1e6),
+            timestamp=buf.pts))
+
+    def _on_breaker_transition(self, old: str, new: str) -> None:
+        from ..fault.breaker import OPEN
+        if new == OPEN:
+            self.stats["breaker_opened"] += 1
+        logger.warning("%s: circuit breaker %s -> %s", self.name, old, new)
+        self.post_message("warning", breaker=new, breaker_from=old,
+                          invoke_errors=self.stats["invoke_errors"],
+                          retry_after_ms=float(self.breaker_retry_after_ms))
 
     # -- QoS throttling ----------------------------------------------------
     def handle_event(self, pad: Pad, event: Event) -> None:

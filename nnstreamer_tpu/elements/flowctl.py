@@ -179,6 +179,7 @@ class TensorRate(TransformElement):
     properties (≙ gsttensor_rate.c in/out/dup/drop)."""
 
     PROPS = {"framerate": "", "throttle": True, "silent": True}
+    RESTART_SAFE = False  # restart loses the PTS schedule mid-stream
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)

@@ -65,6 +65,12 @@ class MqttSink(SinkElement):
         # dropped, until a broker acks them (bounded by max-backlog)
         self._q1_backlog: list = []
         self._next_reconnect = 0.0
+        # exponential reconnect spacing: a long outage must not pay a
+        # 2 s connect stall on every render (reset on the first flush
+        # that reaches the broker again)
+        from ..fault.backoff import Backoff
+        self._reconnect_backoff = Backoff(base=0.25, multiplier=2.0,
+                                          max_s=5.0)
         self.stats["backlog_dropped"] = 0
 
     def _connect(self, timeout: float = 10.0) -> mw.MqttClient:
@@ -144,11 +150,12 @@ class MqttSink(SinkElement):
 
         Two stall guards keep the streaming thread live through an
         outage: reconnects use a short (2 s) connect timeout and back
-        off for 1 s after a failure (frames keep accumulating in the
-        backlog meanwhile, they just don't each pay a connect attempt),
-        and the backlog is capped at max-backlog (oldest frame drops,
-        counted — bounded memory beats a certain OOM that would lose
-        every held frame anyway)."""
+        off exponentially (0.25 s doubling to 5 s) after failures
+        (frames keep accumulating in the backlog meanwhile, they just
+        don't each pay a connect attempt; the ladder resets once a
+        flush succeeds), and the backlog is capped at max-backlog
+        (oldest frame drops, counted — bounded memory beats a certain
+        OOM that would lose every held frame anyway)."""
         cap = max(1, int(self.max_backlog))
         while len(self._q1_backlog) > cap:
             self._q1_backlog.pop(0)
@@ -164,6 +171,7 @@ class MqttSink(SinkElement):
                     # on failure the message sits in client._unacked,
                     # reclaimed below — popped-then-lost cannot happen
                     self._client.publish(topic, payload, qos=1)
+                self._reconnect_backoff.reset()
                 return
             except (ConnectionError, OSError) as exc:
                 dead, self._client = self._client, None
@@ -171,10 +179,12 @@ class MqttSink(SinkElement):
                     self._q1_backlog = dead.take_unacked() \
                         + self._q1_backlog
                     dead.close()
-                self._next_reconnect = time.monotonic() + 1.0
+                delay = self._reconnect_backoff.next()
+                self._next_reconnect = time.monotonic() + delay
                 logger.warning("%s: qos1 publish failed (%s); %d "
-                               "frame(s) held for redelivery", self.name,
-                               exc, len(self._q1_backlog))
+                               "frame(s) held for redelivery, next "
+                               "reconnect in %.2fs", self.name, exc,
+                               len(self._q1_backlog), delay)
 
 
 @register_element("mqttsrc")
@@ -186,11 +196,14 @@ class MqttSrc(SrcElement):
     # by the broker; qos1 deliveries are PUBACKed by the client layer).
     # Reference-parity name (mqttsrc.c:291) — "qos" belongs to base-sink
     # latency throttling, not to MQTT.
+    # reconnect=true: a dropped broker link is re-dialed with
+    # exponential backoff within the timeout budget instead of ending
+    # the stream as EOS (false restores the old die-on-drop behavior)
     PROPS = {"host": "localhost", "port": 1883, "sub-topic": "",
              "client-id": "", "ntp-sync": False,
              "ntp-srvs": "pool.ntp.org:123", "ntp-timeout": 2.0,
              "timeout": 10.0, "is-live": True, "mqtt-qos": 0,
-             "debug": False}
+             "reconnect": True, "debug": False}
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
@@ -198,22 +211,54 @@ class MqttSrc(SrcElement):
         self._base_epoch_ns = 0
         self._caps_sent = False
         self._caps_cache: tuple = ("", None, None)  # (str, Caps, infos)
+        self.stats.update({"reconnects": 0, "link_errors": 0})
 
     def negotiate_src_caps(self) -> Optional[Caps]:
         # caps arrive with the first message; negotiated in-stream
         return None
+
+    def _connect_subscribe(self) -> mw.MqttClient:
+        """The one dial site (start() and every reconnect): connect,
+        arm the per-op timeout, subscribe."""
+        client = mw.MqttClient(
+            self.host, int(self.port),
+            self.client_id or f"nns-tpu-src-{id(self):x}",
+            timeout=self.timeout)
+        client.settimeout(self.timeout)
+        client.subscribe(self.sub_topic, qos=int(self.mqtt_qos))
+        return client
+
+    def _reconnect(self) -> bool:
+        """Re-dial after a dropped broker link; True when resubscribed.
+        Bounded by the timeout budget so a permanently-gone broker
+        still ends the stream instead of spinning forever."""
+        from ..fault.backoff import Backoff
+        client, self._client = self._client, None
+        if client is not None:
+            client.close()
+        deadline = time.monotonic() + float(self.timeout)
+        backoff = Backoff(base=0.1, multiplier=2.0, max_s=2.0)
+        while time.monotonic() < deadline and not self._stop_evt.is_set():
+            try:
+                self._client = self._connect_subscribe()
+            except (ConnectionError, OSError) as exc:
+                logger.info("%s: reconnect attempt failed: %r",
+                            self.name, exc)
+                backoff.sleep(self._stop_evt)
+                continue
+            self.stats["reconnects"] += 1
+            self.post_message("warning",
+                              reconnects=self.stats["reconnects"],
+                              detail="broker link re-established")
+            return True
+        return False
 
     def start(self) -> None:
         if not self.sub_topic:
             raise ValueError(f"{self.name}: 'sub-topic' is required")
         self._base_epoch_ns = synced_epoch_ns(
             self.ntp_srvs if self.ntp_sync else None, self.ntp_timeout)
-        self._client = mw.MqttClient(
-            self.host, int(self.port),
-            self.client_id or f"nns-tpu-src-{id(self):x}",
-            timeout=self.timeout)
-        self._client.settimeout(self.timeout)
-        self._client.subscribe(self.sub_topic, qos=int(self.mqtt_qos))
+        self._client = self._connect_subscribe()
         self._caps_sent = False
         super().start()
 
@@ -238,7 +283,13 @@ class MqttSrc(SrcElement):
             except socket.timeout:
                 logger.warning("%s: no message within timeout", self.name)
                 return None
-            except (ConnectionError, OSError, ValueError):
+            except (ConnectionError, OSError, ValueError) as exc:
+                if self._stop_evt.is_set():
+                    return None
+                self.stats["link_errors"] += 1
+                logger.info("%s: broker link lost (%r)", self.name, exc)
+                if self.reconnect and self._reconnect():
+                    continue
                 return None
             if len(payload) < 1024:
                 logger.warning("%s: short mqtt payload dropped", self.name)

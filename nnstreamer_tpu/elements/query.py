@@ -107,6 +107,7 @@ class TensorQueryServerSrc(SrcElement):
         self._next_client = [0]
         self._accept_thread: Optional[threading.Thread] = None
         self._broker_sock: Optional[socket.socket] = None
+        self.stats["link_errors"] = 0
 
     @property
     def bound_port(self) -> int:
@@ -210,8 +211,13 @@ class TensorQueryServerSrc(SrcElement):
                         self._qlock.notify_all()
                 elif kind == MsgKind.EOS:
                     break
-        except (ConnectionError, OSError, ValueError):
-            pass
+        except (ConnectionError, OSError, ValueError) as exc:
+            # a dying client is routine, but never silent: the cause is
+            # logged and counted so a flapping link is diagnosable from
+            # stats() instead of invisible
+            self.stats["link_errors"] += 1
+            logger.info("%s: client %d connection ended: %r",
+                        self.name, cid, exc)
         finally:
             SERVER_TABLE.remove_conn(self.id, cid)
             # slot reclamation: frames this client queued but the
@@ -395,7 +401,11 @@ class TensorQueryClient(Element):
             if self._sock is not None:
                 return  # lost the race: another thread reconnected
             deadline = time.monotonic() + self.timeout
-            delay = 0.05
+            # shared backoff discipline (fault/backoff.py): exponential
+            # with jitter, so N clients orphaned by one server death
+            # don't hammer the replacement in lockstep
+            from ..fault.backoff import Backoff
+            backoff = Backoff(base=0.05, multiplier=2.0, max_s=1.0)
             last_err: Optional[Exception] = None
             while time.monotonic() < deadline and not self._stop_evt.is_set():
                 # every blocking step below is budgeted out of the SAME
@@ -410,8 +420,7 @@ class TensorQueryClient(Element):
                             return
                 except (ConnectionError, OSError) as e:
                     last_err = e
-                time.sleep(delay)
-                delay = min(delay * 2, 1.0)
+                backoff.sleep(self._stop_evt)
             raise ConnectionError(
                 f"{self.name}: cannot reach a query server: {last_err}")
 

@@ -27,6 +27,7 @@ from ..utils.log import logger
 class TensorTrainer(TransformElement):
     SINK_TEMPLATES = {"sink": "other/tensors"}
     SRC_TEMPLATES = {"src": "other/tensors"}
+    RESTART_SAFE = False  # a restart would lose optimizer/step state
     PROPS = {
         "framework": "jax",
         "model-config": "",

@@ -1,0 +1,23 @@
+"""Fault-tolerance layer: error policies, supervised restarts, link
+backoff, circuit breaking, and fault injection.
+
+See ``Documentation/robustness.md`` for the policy table and the
+breaker state machine; ``tests/test_chaos.py`` is the seeded chaos
+harness driving all of it.
+"""
+from .backoff import Backoff, RestartBudget
+from .breaker import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
+from .errors import (FaultInjected, TransientError, is_transient,
+                     register_fatal, register_transient)
+from .policy import ErrorPolicy, handle_chain_error, policy_of, \
+    restart_element
+from .supervisor import CONTINUE, ESCALATE, RESTART, Supervisor
+
+__all__ = [
+    "Backoff", "RestartBudget", "CircuitBreaker",
+    "CLOSED", "OPEN", "HALF_OPEN",
+    "TransientError", "FaultInjected", "is_transient",
+    "register_transient", "register_fatal",
+    "ErrorPolicy", "policy_of", "handle_chain_error", "restart_element",
+    "Supervisor", "CONTINUE", "RESTART", "ESCALATE",
+]

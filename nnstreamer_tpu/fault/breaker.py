@@ -1,0 +1,99 @@
+"""Circuit breaker for the inference backend path.
+
+States (the classic three-state machine):
+
+    CLOSED ──K consecutive failures──▶ OPEN
+      ▲                                 │ reset timer elapses
+      │ probe succeeds                  ▼
+      └──────────────────────────── HALF_OPEN ──probe fails──▶ OPEN
+
+While OPEN every ``allow()`` answers False — callers shed instead of
+invoking a backend that is currently only producing errors (≙ TF-Serving
+request shedding; fail-fast beats queueing behind a dead accelerator).
+After ``reset_s`` the breaker half-opens and admits exactly ONE probe;
+its outcome closes or re-opens the breaker.
+
+Thread-safe; transitions invoke an optional callback (the filter posts
+them to the bus) and are counted for ``stats()``.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half-open"
+
+
+class CircuitBreaker:
+    def __init__(self, threshold: int = 5, reset_s: float = 1.0,
+                 name: str = "breaker",
+                 on_transition: Optional[Callable[[str, str], None]] = None):
+        self.name = name
+        self.threshold = max(1, int(threshold))
+        self.reset_s = max(0.001, float(reset_s))
+        self._on_transition = on_transition
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive = 0
+        self._opened_at = 0.0
+        self._probe_inflight = False
+        self.stats = {"opened": 0, "closed": 0, "rejected": 0}
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._maybe_half_open_locked()
+            return self._state
+
+    def _transition_locked(self, new: str) -> None:
+        old, self._state = self._state, new
+        if new == OPEN:
+            self.stats["opened"] += 1
+            self._opened_at = time.monotonic()
+        elif new == CLOSED:
+            self.stats["closed"] += 1
+        cb = self._on_transition
+        if cb is not None and old != new:
+            # called under the lock: transitions are strictly ordered and
+            # callbacks (a bus post) are cheap/non-reentrant
+            cb(old, new)
+
+    def _maybe_half_open_locked(self) -> None:
+        if self._state == OPEN \
+                and time.monotonic() - self._opened_at >= self.reset_s:
+            self._probe_inflight = False
+            self._transition_locked(HALF_OPEN)
+
+    def allow(self) -> bool:
+        """May the caller invoke the backend now? False = shed. In
+        HALF_OPEN exactly one caller gets True (the probe)."""
+        with self._lock:
+            self._maybe_half_open_locked()
+            if self._state == CLOSED:
+                return True
+            if self._state == HALF_OPEN and not self._probe_inflight:
+                self._probe_inflight = True
+                return True
+            self.stats["rejected"] += 1
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive = 0
+            self._probe_inflight = False
+            if self._state != CLOSED:
+                self._transition_locked(CLOSED)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._consecutive += 1
+            if self._state == HALF_OPEN:
+                # the probe failed: back to OPEN, re-arm the timer
+                self._probe_inflight = False
+                self._transition_locked(OPEN)
+            elif self._state == CLOSED \
+                    and self._consecutive >= self.threshold:
+                self._transition_locked(OPEN)

@@ -1,0 +1,108 @@
+"""tensor_fault — deterministic fault injection for chaos testing.
+
+A passthrough element that injects failures into a live pipeline on a
+seeded, reproducible schedule — the chaos harness's hand on the wheel::
+
+    ... ! tensor_fault mode=transient every=5 on-error=retry ! ...
+
+Modes:
+
+* ``raise``      — raise RuntimeError (classified FATAL)
+* ``transient``  — raise :class:`~..errors.FaultInjected`
+                   (a TransientError: retry policies apply)
+* ``delay``      — sleep ``delay-ms`` then pass the buffer through
+* ``corrupt``    — invert the first chunk's bytes (shape/dtype intact:
+                   caps stay valid, the VALUES are garbage)
+* ``drop``       — swallow the buffer (counted in ``stats['dropped']``)
+
+Firing: ``every=N`` fires on every Nth ``transform`` call (N>0), else
+``probability=p`` fires per-call from a ``seed``-ed RNG — both replay
+identically run to run. ``max-faults`` caps the total injected (-1 =
+unlimited). ``stats['faults']`` counts injections, so a chaos test can
+assert every injected fault is accounted for as retried/skipped/shed.
+
+Note the every-N counter counts *calls*: when an ``on-error=retry``
+policy re-runs the failed buffer, the retry is call N+1 and passes —
+i.e. a transient fault heals on first retry, exactly the fault shape
+retry policies exist for.
+"""
+from __future__ import annotations
+
+import random
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..pipeline.element import TransformElement
+from ..pipeline.registry import register_element
+from ..tensors.buffer import Buffer, Chunk
+from .errors import FaultInjected
+
+_MODES = ("raise", "transient", "delay", "corrupt", "drop")
+
+
+@register_element("tensor_fault")
+class TensorFault(TransformElement):
+    PROPS = {"mode": "transient",
+             "every": 0,          # fire on every Nth call; 0 = use probability
+             "probability": 0.0,  # per-call fire probability when every=0
+             "seed": 0,           # RNG seed: schedules replay exactly
+             "delay-ms": 10.0,    # sleep length for mode=delay
+             "max-faults": -1}    # total injection cap; -1 = unlimited
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._rng = random.Random(int(self.seed))
+        self._calls = 0
+        self.stats.update({"faults": 0, "passed": 0})
+
+    def start(self) -> None:
+        super().start()
+        # a restart (on-error=restart) replays the schedule from zero —
+        # the element is restart-safe BECAUSE its state is just this
+        self._rng = random.Random(int(self.seed))
+        self._calls = 0
+
+    def _should_fire(self) -> bool:
+        self._calls += 1
+        mf = int(self.max_faults)
+        if 0 <= mf <= self.stats["faults"]:
+            return False
+        every = int(self.every)
+        if every > 0:
+            return self._calls % every == 0
+        p = float(self.probability)
+        return p > 0 and self._rng.random() < p
+
+    def transform(self, buf: Buffer) -> Optional[Buffer]:
+        if not self._should_fire():
+            self.stats["passed"] += 1
+            return buf
+        n = self.stats["faults"] = self.stats["faults"] + 1
+        mode = str(self.mode)
+        if mode == "raise":
+            raise RuntimeError(
+                f"{self.name}: injected fatal fault #{n} "
+                f"(call {self._calls})")
+        if mode == "transient":
+            raise FaultInjected(
+                f"{self.name}: injected transient fault #{n} "
+                f"(call {self._calls})")
+        if mode == "delay":
+            time.sleep(max(0.0, float(self.delay_ms)) / 1e3)
+            return buf
+        if mode == "corrupt":
+            if not buf.chunks:
+                return buf
+            host = np.array(buf.chunks[0].host(), copy=True)
+            flat = host.view(np.uint8)
+            flat ^= 0xFF  # every byte inverted: loud, shape-preserving
+            out = buf.with_chunks([Chunk(host)] +
+                                  list(buf.chunks[1:]))
+            return out
+        if mode == "drop":
+            self.stats["dropped"] += 1
+            return None
+        raise ValueError(f"{self.name}: unknown mode {mode!r} "
+                         f"(expected one of {_MODES})")

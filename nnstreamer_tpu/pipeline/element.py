@@ -59,7 +59,14 @@ class Element:
 
     SINK_TEMPLATES: Dict[str, Optional[str]] = {}
     SRC_TEMPLATES: Dict[str, Optional[str]] = {}
-    PROPS: Dict[str, Any] = {}
+    # every element accepts on-error (fault/policy.py grammar):
+    # fail | skip | retry[(n[,backoff_s[,jitter]])] |
+    # restart[(budget[,window_s])]. Default preserves the historical
+    # behavior: any chain exception aborts the pipeline.
+    PROPS: Dict[str, Any] = {"on-error": "fail"}
+    # elements opting into on_error=restart declare that stop()/start()
+    # rebuilds them losslessly (pipelint errors on restart otherwise)
+    RESTART_SAFE = False
 
     _anon_counter = [0]
 
@@ -78,7 +85,12 @@ class Element:
         self.src_pads: Dict[str, Pad] = {}
         self._eos_seen: set = set()
         self._started = False
-        self.stats = {"buffers": 0, "bytes": 0, "proctime_ns": 0, "events": 0}
+        self.stats = {"buffers": 0, "bytes": 0, "proctime_ns": 0,
+                      "events": 0,
+                      # fault-policy accounting (fault/policy.py): how
+                      # many buffers were skipped/shed, retried, and how
+                      # often the element was bounced by on-error=restart
+                      "dropped": 0, "retries": 0, "restarts": 0}
         # merged property table from the full class hierarchy
         self._prop_defaults: Dict[str, Any] = {}
         for klass in reversed(type(self).__mro__):
@@ -137,10 +149,15 @@ class Element:
     # -- properties -------------------------------------------------------
     def set_property(self, key: str, value: Any) -> None:
         attr = key.replace("-", "_")
+        dashed = key.replace("_", "-")
         if key in self._prop_defaults:
             setattr(self, attr, _coerce(value, self._prop_defaults[key]))
         elif attr in self._prop_defaults:
             setattr(self, attr, _coerce(value, self._prop_defaults[attr]))
+        elif dashed in self._prop_defaults:
+            # launch strings may spell a dashed property with
+            # underscores (on_error=skip for on-error)
+            setattr(self, attr, _coerce(value, self._prop_defaults[dashed]))
         else:
             raise ValueError(f"{type(self).__name__} has no property {key!r}")
 
@@ -170,10 +187,13 @@ class Element:
             self.do_chain(pad, item)
         except FlowError:
             raise
-        except Exception as exc:  # noqa: BLE001 -- post to bus like GST_ELEMENT_ERROR
-            logger.exception("%s: error in chain", self.name)
-            self.post_error(exc)
-            raise FlowError(f"{self.name}: {exc}") from exc
+        except Exception as exc:  # noqa: BLE001 -- apply the element's on-error policy
+            # fail (default) posts the error and raises FlowError like
+            # GST_ELEMENT_ERROR always did; skip/retry/restart may
+            # consume or recover the buffer (fault/policy.py)
+            from ..fault.policy import handle_chain_error
+            if not handle_chain_error(self, pad, item, exc):
+                return  # buffer consumed by the policy (skipped)
         dt = time.perf_counter_ns() - t0
         self.stats["buffers"] += 1
         self.stats["bytes"] += item.nbytes
@@ -290,6 +310,10 @@ class TransformElement(Element):
 
     SINK_TEMPLATES = {"sink": None}
     SRC_TEMPLATES = {"src": None}
+    # pure per-buffer transforms rebuild losslessly from stop()/start();
+    # transforms that accumulate cross-buffer state (aggregator,
+    # trainer, rate) opt back out
+    RESTART_SAFE = True
 
     def do_chain(self, pad: Pad, buf: Buffer) -> None:
         out = self.transform(buf)
@@ -311,15 +335,37 @@ class TransformElement(Element):
         return {p: out for p in self.src_pads}
 
 
+class _StreamRestart(Exception):
+    """Control flow: a supervised create() failure was decided RESTART
+    inside _stream; _loop replays the preamble without re-handling."""
+
+    def __init__(self, cause: Exception):
+        super().__init__(str(cause))
+        self.cause = cause
+
+
+class _StreamEscalate(Exception):
+    """Control flow: a supervised create() failure exhausted its policy
+    inside _stream; _loop posts the pipeline error without re-handling."""
+
+    def __init__(self, cause: Exception):
+        super().__init__(str(cause))
+        self.cause = cause
+
+
 class SrcElement(Element):
     """Source with its own streaming thread (≙ GstBaseSrc).
 
     Subclasses implement ``negotiate_src_caps()`` (fixed caps for the
-    stream) and ``create()`` returning a Buffer or None for EOS.
+    stream) and ``create()`` returning a Buffer or None for EOS. The
+    thread runs supervised: see :meth:`_loop` and fault/supervisor.py.
     """
 
     SRC_TEMPLATES = {"src": None}
     PROPS = {"num-buffers": -1}
+    # restart for a source is a loop-level stream replay (on_restart
+    # hook + preamble), which every source supports by construction
+    RESTART_SAFE = True
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
@@ -347,30 +393,91 @@ class SrcElement(Element):
             self._thread.join(timeout=5.0)
             self._thread = None
 
+    def on_restart(self) -> None:
+        """Hook for supervised stream restarts (on-error=restart):
+        re-acquire whatever resource the stream reads from (re-open a
+        socket, re-subscribe). The preamble — StreamStart, caps,
+        segment — is replayed by the loop itself."""
+
     def _loop(self) -> None:
+        """Supervised streaming loop: failures escaping the stream body
+        go through a fault.Supervisor applying the element's on-error
+        policy (backoff + jitter, restart budget) before the historical
+        escalate-to-pipeline-error path (fault/supervisor.py)."""
+        from ..fault.supervisor import CONTINUE, RESTART, Supervisor
         try:
-            self.srcpad.push(StreamStart(stream_id=self.name))
-            caps = self.negotiate_src_caps()
-            if caps is not None:
-                self.set_src_caps(caps)
-            self.srcpad.push(SegmentEvent())
-            while not self._stop_evt.is_set():
-                if 0 <= self.num_buffers <= self._pushed:
-                    break
-                buf = self.create()
-                if buf is None:
-                    break
-                tracer = getattr(self.pipeline, "tracer", None)
-                if tracer is not None:
-                    tracer.stamp(buf)
-                self.srcpad.push(buf)
-                self._pushed += 1
-            self.srcpad.push(EosEvent())
-        except FlowError:
-            pass  # error already posted by the failing element
-        except Exception as exc:  # noqa: BLE001
-            logger.exception("%s: error in src loop", self.name)
+            sup = Supervisor(self)
+        except Exception as exc:  # noqa: BLE001 — unparseable on-error spec
+            logger.exception("%s: bad on-error policy", self.name)
             self.post_error(exc)
+            return
+        while not self._stop_evt.is_set():
+            try:
+                self._stream(sup)
+                return
+            except FlowError:
+                return  # error already posted by the failing element
+            except _StreamRestart:
+                try:
+                    self.on_restart()
+                    continue  # replay preamble: caps re-negotiated
+                except Exception as exc:  # noqa: BLE001
+                    logger.exception("%s: restart hook failed", self.name)
+                    self.post_error(exc)
+                    return
+            except Exception as exc:  # noqa: BLE001
+                if isinstance(exc, _StreamEscalate):
+                    exc = exc.cause
+                else:
+                    decision = sup.handle(exc, where="src-loop")
+                    if decision == RESTART:
+                        try:
+                            self.on_restart()
+                            continue
+                        except Exception as exc2:  # noqa: BLE001
+                            exc = exc2
+                    elif decision == CONTINUE:
+                        continue
+                logger.exception("%s: error in src loop", self.name)
+                self.post_error(exc)
+                return
+
+    def _stream(self, sup=None) -> None:
+        """One full streaming pass: preamble, create() loop, EOS."""
+        self.srcpad.push(StreamStart(stream_id=self.name))
+        caps = self.negotiate_src_caps()
+        if caps is not None:
+            self.set_src_caps(caps)
+        self.srcpad.push(SegmentEvent())
+        while not self._stop_evt.is_set():
+            if 0 <= self.num_buffers <= self._pushed:
+                break
+            try:
+                buf = self.create()
+            except FlowError:
+                raise
+            except Exception as exc:  # noqa: BLE001 — per-frame policy site
+                if sup is None:
+                    raise
+                from ..fault.supervisor import CONTINUE, RESTART
+                decision = sup.handle(exc, where="create")
+                if decision == CONTINUE:
+                    continue  # frame skipped or retry backoff elapsed
+                # the decision (budget slot, backoff, bus warning) is
+                # already made — _loop must honor it, not re-handle
+                if decision == RESTART:
+                    raise _StreamRestart(exc) from exc
+                raise _StreamEscalate(exc) from exc
+            if sup is not None:
+                sup.ok()
+            if buf is None:
+                break
+            tracer = getattr(self.pipeline, "tracer", None)
+            if tracer is not None:
+                tracer.stamp(buf)
+            self.srcpad.push(buf)
+            self._pushed += 1
+        self.srcpad.push(EosEvent())
 
 
 class SinkElement(Element):

@@ -71,6 +71,7 @@ class TensorServeSrc(SrcElement):
         self._conns: Dict[int, Tuple[socket.socket, threading.Lock]] = {}
         self._clock = threading.Lock()
         self.scheduler: Optional[ServeScheduler] = None
+        self.stats["link_errors"] = 0
 
     @property
     def bound_port(self) -> int:
@@ -153,8 +154,12 @@ class TensorServeSrc(SrcElement):
                     self._admit(conn, cid, meta, payloads)
                 elif kind == MsgKind.EOS:
                     break
-        except (ConnectionError, OSError, ValueError):
-            pass
+        except (ConnectionError, OSError, ValueError) as exc:
+            # routine client death, but logged + counted (never a bare
+            # discard): flapping clients must show up in stats()
+            self.stats["link_errors"] += 1
+            logger.info("%s: client %d connection ended: %r",
+                        self.name, cid, exc)
         finally:
             # slot reclamation: a stream that dies mid-request must not
             # wedge the batcher or leak its queued slots

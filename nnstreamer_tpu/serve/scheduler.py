@@ -66,7 +66,7 @@ class ServeScheduler:
         self._queue_delay = Reservoir()
         self._batch_latency = Reservoir()
         self.stats = {"completed": 0, "rows_padded": 0, "bucket_rows": 0,
-                      "result_errors": 0}
+                      "result_errors": 0, "invoke_errors": 0}
 
     # -- producers ---------------------------------------------------------
     def submit(self, stream_id: Any, arrays: Sequence[Any], *,
@@ -158,6 +158,7 @@ class ServeScheduler:
             "shed_deadline": b["shed_deadline"],
             "cancelled": b["cancelled"],
             "result_errors": s["result_errors"],
+            "invoke_errors": s["invoke_errors"],
             "occupancy_avg": (filled / s["bucket_rows"]
                               if s["bucket_rows"] else 0.0),
             "queue_delay_us": {k: v / 1e3 for k, v in qd.items()},
@@ -192,9 +193,11 @@ class ServeScheduler:
             batch, _bucket, stacked = nb
             try:
                 outputs = self._invoke_fn(stacked)
-            except Exception:  # noqa: BLE001 — shed the batch, keep serving
-                logger.warning("%s: invoke failed, batch shed", self.name,
-                               exc_info=True)
+            except Exception as exc:  # noqa: BLE001 — shed the batch, keep serving
+                with self._mlock:
+                    self.stats["invoke_errors"] += 1
+                logger.warning("%s: invoke failed (%r), batch of %d shed",
+                               self.name, exc, len(batch), exc_info=True)
                 for r in batch:
                     if r.on_shed is not None:
                         r.on_shed(r)

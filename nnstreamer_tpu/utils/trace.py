@@ -159,6 +159,11 @@ class Tracer:
                 if st.get("buffers"):
                     entry["proctime_us_avg"] = (st["proctime_ns"] /
                                                 st["buffers"] / 1e3)
+                # fault accounting: only shown when something actually
+                # happened, so healthy reports stay uncluttered
+                for key in ("dropped", "retries", "restarts", "shed"):
+                    if st.get(key):
+                        entry[key] = st[key]
                 q = getattr(el, "_q", None)
                 if q is not None and hasattr(q, "qsize"):
                     entry["queue_level"] = q.qsize()

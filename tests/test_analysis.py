@@ -188,6 +188,60 @@ class TestRules:
         report = analyze(parse_launch(desc))
         assert report.exit_code == 0  # info never fails the gate
 
+    def test_link_resilience_no_timeout(self):
+        bad = (  # pipelint: skip — timeout=0 hangs on a dead peer
+            f"tensortestsrc caps={CAPS_U8} ! "
+            "tensor_query_client name=qc timeout=0 ! appsink name=out")
+        got = findings_for(bad, "link-resilience")
+        assert [(f.element, f.severity) for f in got] == \
+            [("qc", Severity.WARNING)]
+        assert "timeout" in got[0].message
+
+    def test_link_resilience_reconnect_disabled_is_info(self):
+        desc = "edgesrc name=e reconnect=false ! appsink name=out"
+        got = findings_for(desc, "link-resilience")
+        assert [(f.element, f.severity) for f in got] == \
+            [("e", Severity.INFO)]
+        assert "reconnect" in got[0].message
+
+    def test_link_resilience_defaults_are_clean(self):
+        desc = "edgesrc name=e ! appsink name=out"
+        assert findings_for(desc, "link-resilience") == []
+
+    def test_error_policy_bad_spec_is_error(self):
+        bad = (  # pipelint: skip — typo'd on-error spec
+            f"tensortestsrc caps={CAPS_U8} ! "
+            "identity name=i on_error=explode ! appsink name=out")
+        got = findings_for(bad, "error-policy")
+        assert [(f.element, f.severity) for f in got] == \
+            [("i", Severity.ERROR)]
+        assert "explode" in got[0].message
+
+    def test_error_policy_retry_on_sink_warns(self):
+        bad = (  # pipelint: skip — retry on a sink re-runs side effects
+            f"tensortestsrc caps={CAPS_U8} ! "
+            "fakesink name=k on_error=retry(2)")
+        got = findings_for(bad, "error-policy")
+        assert [(f.element, f.severity) for f in got] == \
+            [("k", Severity.WARNING)]
+        assert "side effects" in got[0].message
+
+    def test_error_policy_restart_on_stateful_is_error(self):
+        bad = (  # pipelint: skip — restart discards the aggregation window
+            f"tensortestsrc caps={CAPS_U8} ! "
+            "tensor_aggregator name=agg frames-out=2 on_error=restart ! "
+            "appsink name=out")
+        got = findings_for(bad, "error-policy")
+        assert [(f.element, f.severity) for f in got] == \
+            [("agg", Severity.ERROR)]
+        assert "restart-safe" in got[0].message
+
+    def test_error_policy_valid_specs_are_clean(self):
+        desc = (f"tensortestsrc caps={CAPS_U8} on_error=retry(3,0.1) ! "
+                "identity on_error=skip ! tensor_fault mode=drop every=9 "
+                "on_error=restart ! appsink name=out")
+        assert findings_for(desc, "error-policy") == []
+
 
 CLEAN_CORPUS = [
     # straight filter chain on fixed caps

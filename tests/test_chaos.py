@@ -1,0 +1,646 @@
+"""Fault-tolerance layer: policies, supervision, breaker, chaos harness.
+
+Unit coverage for nnstreamer_tpu.fault (classification, backoff,
+budget, policy parsing, circuit breaker, tensor_fault determinism),
+pipeline-level policy semantics (skip/retry/restart/fail at the chain
+site and under source supervision), and the seeded chaos acceptance
+scenario: transient faults injected into the source, the filter path,
+and the query link of a serve pipeline complete with zero pipeline
+aborts and exact stats accounting — while the same schedule under
+``fail`` policies reproduces the historical abort.
+"""
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import Buffer, parse_launch
+from nnstreamer_tpu.fault import (CLOSED, HALF_OPEN, OPEN, Backoff,
+                                  CircuitBreaker, ErrorPolicy, FaultInjected,
+                                  RestartBudget, TransientError, is_transient,
+                                  register_fatal, register_transient)
+from nnstreamer_tpu.fault import errors as fault_errors
+from nnstreamer_tpu.filters import register_custom_easy
+from nnstreamer_tpu.pipeline.element import SrcElement
+from nnstreamer_tpu.pipeline.registry import make_element, register_element
+from nnstreamer_tpu.tensors.buffer import Chunk
+from nnstreamer_tpu.tensors.caps import Caps
+
+CAPS_U8 = "other/tensors,format=static,num_tensors=1,types=uint8,dimensions=4"
+
+
+# ------------------------------------------------------------- unit layer
+
+class TestClassification:
+    def test_transient_types(self):
+        assert is_transient(TransientError("x"))
+        assert is_transient(FaultInjected("x"))
+        assert is_transient(socket.timeout())
+        assert is_transient(ConnectionResetError())
+        assert is_transient(TimeoutError())
+
+    def test_fatal_by_default(self):
+        assert not is_transient(ValueError("x"))
+        assert not is_transient(RuntimeError("x"))
+        assert not is_transient(KeyError("x"))
+
+    def test_registry_extension(self):
+        class MyFlaky(Exception):
+            pass
+
+        class MyFatal(TransientError):
+            pass
+
+        saved_t = fault_errors._TRANSIENT_TYPES
+        saved_f = fault_errors._FATAL_TYPES
+        try:
+            register_transient(MyFlaky)
+            assert is_transient(MyFlaky())
+            # fatal registration wins over an inherited transient base
+            register_fatal(MyFatal)
+            assert not is_transient(MyFatal())
+        finally:
+            fault_errors._TRANSIENT_TYPES = saved_t
+            fault_errors._FATAL_TYPES = saved_f
+
+
+class TestErrorPolicyParse:
+    def test_defaults(self):
+        p = ErrorPolicy.parse("fail")
+        assert p.action == "fail"
+        assert ErrorPolicy.parse("skip").action == "skip"
+
+    def test_retry_args(self):
+        p = ErrorPolicy.parse("retry(5,0.2,0.1)")
+        assert (p.action, p.max_retries, p.backoff_s, p.jitter) \
+            == ("retry", 5, 0.2, 0.1)
+        assert ErrorPolicy.parse("retry").max_retries == 3
+        assert ErrorPolicy.parse("retry(2)").max_retries == 2
+
+    def test_restart_args(self):
+        p = ErrorPolicy.parse("restart(7,12.5)")
+        assert (p.action, p.restart_budget, p.window_s) == ("restart", 7, 12.5)
+
+    def test_whitespace_tolerated(self):
+        assert ErrorPolicy.parse(" retry( 2 , 0.1 ) ").max_retries == 2
+
+    @pytest.mark.parametrize("bad", [
+        "explode", "retry(", "retry(a)", "fail(1)", "skip(2)",
+        "retry(1,2,3,4)", "restart(x)"])
+    def test_bad_specs_raise(self, bad):
+        with pytest.raises(ValueError):
+            ErrorPolicy.parse(bad)
+
+    def test_empty_spec_is_the_default(self):
+        assert ErrorPolicy.parse("").action == "fail"
+
+
+class TestBackoff:
+    def test_deterministic_ladder_without_jitter(self):
+        b = Backoff(base=0.1, multiplier=2.0, max_s=1.0, jitter=0.0)
+        assert [b.next() for _ in range(5)] == [0.1, 0.2, 0.4, 0.8, 1.0]
+
+    def test_jitter_bounds_and_seed(self):
+        a = Backoff(base=0.1, jitter=0.5, seed=7)
+        b = Backoff(base=0.1, jitter=0.5, seed=7)
+        da, db = [a.next() for _ in range(6)], [b.next() for _ in range(6)]
+        assert da == db  # seeded: reproducible
+        for i, d in enumerate(da):
+            full = min(2.0, 0.1 * 2.0 ** i)
+            assert full * 0.5 <= d <= full
+
+    def test_reset(self):
+        b = Backoff(base=0.1, jitter=0.0)
+        b.next(), b.next()
+        b.reset()
+        assert b.next() == 0.1
+
+    def test_sleep_interruptible(self):
+        evt = threading.Event()
+        evt.set()
+        b = Backoff(base=5.0, jitter=0.0)
+        t0 = time.monotonic()
+        b.sleep(evt)
+        assert time.monotonic() - t0 < 1.0
+
+
+class TestRestartBudget:
+    def test_exhausts_then_allows_after_window(self):
+        budget = RestartBudget(limit=2, window_s=0.2)
+        assert budget.allow() and budget.allow()
+        assert not budget.allow()
+        time.sleep(0.25)
+        assert budget.allow()  # the window slid past the old restarts
+
+
+class TestCircuitBreaker:
+    def test_opens_at_threshold_and_sheds(self):
+        cb = CircuitBreaker(threshold=3, reset_s=60.0)
+        for _ in range(2):
+            cb.record_failure()
+        assert cb.state == CLOSED and cb.allow()
+        cb.record_failure()
+        assert cb.state == OPEN
+        assert not cb.allow() and not cb.allow()
+        assert cb.stats["rejected"] == 2
+
+    def test_success_resets_consecutive_count(self):
+        cb = CircuitBreaker(threshold=3, reset_s=60.0)
+        cb.record_failure(), cb.record_failure()
+        cb.record_success()
+        cb.record_failure(), cb.record_failure()
+        assert cb.state == CLOSED  # never 3 consecutive
+
+    def test_half_open_single_probe_then_close(self):
+        cb = CircuitBreaker(threshold=1, reset_s=0.05)
+        cb.record_failure()
+        assert cb.state == OPEN
+        time.sleep(0.08)
+        assert cb.state == HALF_OPEN
+        assert cb.allow()          # the one probe
+        assert not cb.allow()      # concurrent callers are still shed
+        cb.record_success()
+        assert cb.state == CLOSED and cb.allow()
+
+    def test_half_open_probe_failure_reopens(self):
+        cb = CircuitBreaker(threshold=1, reset_s=0.05)
+        cb.record_failure()
+        time.sleep(0.08)
+        assert cb.allow()
+        cb.record_failure()
+        assert cb.state == OPEN and not cb.allow()
+
+    def test_transition_callback_sequence(self):
+        seen = []
+        cb = CircuitBreaker(threshold=1, reset_s=0.05,
+                            on_transition=lambda o, n: seen.append((o, n)))
+        cb.record_failure()
+        time.sleep(0.08)
+        cb.allow()
+        cb.record_success()
+        assert seen == [(CLOSED, OPEN), (OPEN, HALF_OPEN),
+                        (HALF_OPEN, CLOSED)]
+
+
+class TestTensorFault:
+    def _buf(self, v=1):
+        return Buffer([Chunk(np.full(4, v, np.uint8))], pts=v)
+
+    def test_every_n_is_deterministic(self):
+        f = make_element("tensor_fault", mode="transient", every=3)
+        f.start()
+        fired = []
+        for i in range(9):
+            try:
+                f.transform(self._buf(i))
+                fired.append(False)
+            except FaultInjected:
+                fired.append(True)
+        assert fired == [False, False, True] * 3
+        assert f.stats["faults"] == 3
+
+    def test_probability_is_seeded(self):
+        def run():
+            f = make_element("tensor_fault", mode="transient",
+                             probability=0.5, seed=99)
+            f.start()
+            out = []
+            for i in range(20):
+                try:
+                    f.transform(self._buf(i))
+                    out.append(0)
+                except FaultInjected:
+                    out.append(1)
+            return out
+        a, b = run(), run()
+        assert a == b and 0 < sum(a) < 20
+
+    def test_start_resets_schedule(self):
+        f = make_element("tensor_fault", mode="transient", every=2)
+        f.start()
+        with pytest.raises(FaultInjected):
+            f.transform(self._buf()), f.transform(self._buf())
+        f.stop()
+        f.start()  # restart-safe: the schedule replays from call 1
+        f.transform(self._buf())  # call 1 of 2: passes again
+        with pytest.raises(FaultInjected):
+            f.transform(self._buf())
+
+    def test_corrupt_flips_payload_bytes(self):
+        f = make_element("tensor_fault", mode="corrupt", every=1)
+        f.start()
+        out = f.transform(self._buf(5))
+        assert (np.asarray(out.chunks[0].host()) == 5 ^ 0xFF).all()
+
+    def test_drop_returns_none_and_counts(self):
+        f = make_element("tensor_fault", mode="drop", every=2)
+        f.start()
+        assert f.transform(self._buf()) is not None
+        assert f.transform(self._buf()) is None
+        assert f.stats["dropped"] == 1
+
+    def test_max_faults_caps_injection(self):
+        f = make_element("tensor_fault", mode="drop", every=1,
+                         **{"max-faults": 2})
+        f.start()
+        assert f.transform(self._buf()) is None
+        assert f.transform(self._buf()) is None
+        assert f.transform(self._buf()) is not None  # budget spent
+        assert f.stats["faults"] == 2
+
+
+# ------------------------------------------------- pipeline-level policies
+
+def _run(desc, timeout=30):
+    p = parse_launch(desc)
+    p.start()
+    p.wait_eos(timeout=timeout)
+    p.stop()
+    return p.stats()
+
+
+class TestChainPolicies:
+    def test_skip_drops_faulted_buffers_and_counts(self):
+        st = _run("videotestsrc num-buffers=9 ! tensor_converter ! "
+                  "tensor_fault mode=raise every=3 on_error=skip name=f "
+                  "! tensor_sink name=s")
+        assert st["f"]["dropped"] == 3
+        assert st["s"]["buffers"] == 6  # bounded loss: exactly the faults
+
+    def test_retry_heals_transient_with_zero_loss(self):
+        st = _run("videotestsrc num-buffers=9 ! tensor_converter ! "
+                  "tensor_fault mode=transient every=3 "
+                  "on_error=retry(2,0.01) name=f ! tensor_sink name=s")
+        assert st["s"]["buffers"] == 9  # every fault healed on retry
+        assert st["f"]["retries"] == 4  # calls 3,6,9,12 fire; retries pass
+
+    def test_retry_escalates_on_fatal(self):
+        p = parse_launch("videotestsrc num-buffers=9 ! tensor_converter ! "
+                         "tensor_fault mode=raise every=3 "
+                         "on_error=retry(5,0.01) ! tensor_sink")
+        p.start()
+        with pytest.raises(RuntimeError, match="injected fatal"):
+            p.wait_eos(timeout=30)
+        p.stop()
+
+    def test_retry_exhaustion_escalates(self):
+        # every=1: the fault re-fires on every retry, so the ladder runs dry
+        p = parse_launch("videotestsrc num-buffers=4 ! tensor_converter ! "
+                         "tensor_fault mode=transient every=1 "
+                         "on_error=retry(2,0.01) ! tensor_sink")
+        p.start()
+        with pytest.raises(FaultInjected):
+            p.wait_eos(timeout=30)
+        p.stop()
+
+    def test_fail_reproduces_historical_abort(self):
+        # acceptance: the same schedule under the default policy aborts
+        p = parse_launch("videotestsrc num-buffers=9 ! tensor_converter ! "
+                         "tensor_fault mode=transient every=3 "
+                         "! tensor_sink")
+        p.start()
+        with pytest.raises(FaultInjected):
+            p.wait_eos(timeout=30)
+        p.stop()
+
+    def test_restart_replays_and_heals(self):
+        st = _run("videotestsrc num-buffers=8 ! tensor_converter ! "
+                  "tensor_fault mode=transient every=3 "
+                  "on_error=restart(8,30) name=f ! tensor_sink name=s")
+        assert st["s"]["buffers"] == 8  # restart + replay: zero loss
+        assert st["f"]["restarts"] >= 1
+
+    def test_restart_budget_exhaustion_escalates(self):
+        # every=2 faults recur forever; a 1-restart budget must escalate
+        p = parse_launch("videotestsrc num-buffers=32 ! tensor_converter ! "
+                         "tensor_fault mode=transient every=2 "
+                         "on_error=restart(1,30) ! tensor_sink")
+        p.start()
+        with pytest.raises(FaultInjected):
+            p.wait_eos(timeout=30)
+        p.stop()
+
+    def test_bad_policy_spec_rejected_at_launch(self):
+        from nnstreamer_tpu.analysis import PipelineValidationError
+        p = parse_launch(  # pipelint: skip — intentionally typo'd policy
+            "videotestsrc num-buffers=4 ! tensor_converter ! "
+            "tensor_fault mode=transient every=2 "
+            "on_error=explode ! tensor_sink")
+        with pytest.raises(PipelineValidationError, match="on-error"):
+            p.start()  # the error-policy lint rule gates the launch
+
+    def test_bad_policy_spec_fails_at_first_fault_unvalidated(self):
+        # escape hatch: skip the lint gate — the spec still fails the
+        # pipeline at the first fault instead of silently defaulting
+        p = parse_launch(  # pipelint: skip — intentionally typo'd policy
+            "videotestsrc num-buffers=4 ! tensor_converter ! "
+            "tensor_fault mode=transient every=2 "
+            "on_error=explode ! tensor_sink")
+        p.validate_on_start = False
+        p.start()
+        with pytest.raises(ValueError, match="on-error"):
+            p.wait_eos(timeout=30)
+        p.stop()
+
+    def test_tee_branch_fault_is_isolated_by_skip(self):
+        st = _run("videotestsrc num-buffers=8 ! tensor_converter ! tee name=t "
+                  "t. ! queue ! tensor_fault mode=raise every=4 on_error=skip "
+                  "name=f ! tensor_sink name=a "
+                  "t. ! queue ! tensor_sink name=b")
+        assert st["b"]["buffers"] == 8   # clean branch: untouched
+        assert st["a"]["buffers"] == 6   # faulty branch: bounded loss
+        assert st["f"]["dropped"] == 2
+
+    def test_stats_and_trace_surface_fault_counters(self):
+        p = parse_launch("videotestsrc num-buffers=9 ! tensor_converter ! "
+                         "tensor_fault mode=transient every=3 "
+                         "on_error=retry(2,0.01) name=f ! tensor_sink")
+        tracer = p.enable_tracing()
+        p.start()
+        p.wait_eos(timeout=30)
+        rep = tracer.report(p)
+        p.stop()
+        assert rep["f"]["retries"] == p.stats()["f"]["retries"] > 0
+        assert "dropped" not in rep["f"]  # zero counters stay hidden
+
+
+# --------------------------------------------------- supervised source
+
+@register_element("chaos_flaky_src")
+class ChaosFlakySrc(SrcElement):
+    """Emits ``num-buffers`` frames; the first attempt at every
+    ``every``-th frame raises TransientError. The cursor only advances
+    on success, so a retried attempt yields the SAME frame — recovery
+    means zero loss, not resumed-with-holes."""
+
+    PROPS = {"num-buffers": 6, "every": 3}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._n = 0
+        self._failed_once = set()
+
+    def negotiate_src_caps(self):
+        return Caps(CAPS_U8)
+
+    def create(self):
+        if self._n >= int(self.num_buffers):
+            return None
+        item = self._n
+        if (item + 1) % int(self.every) == 0 \
+                and item not in self._failed_once:
+            self._failed_once.add(item)
+            raise TransientError(f"{self.name}: flaky read at {item}")
+        self._n += 1
+        return Buffer([Chunk(np.full(4, item, np.uint8))], pts=item)
+
+
+class TestSourceSupervision:
+    def test_retry_recovers_all_frames(self):
+        st = _run("chaos_flaky_src num-buffers=9 every=3 "
+                  "on_error=retry(3,0.01) name=src ! tensor_sink name=s")
+        assert st["s"]["buffers"] == 9  # the retried frames were replayed
+        assert st["src"]["retries"] == 3
+
+    def test_fail_policy_aborts_the_stream(self):
+        p = parse_launch("chaos_flaky_src num-buffers=9 every=3 name=src "
+                         "! tensor_sink")
+        p.start()
+        with pytest.raises(TransientError):
+            p.wait_eos(timeout=30)
+        p.stop()
+
+    def test_restart_policy_restarts_the_loop(self):
+        st = _run("chaos_flaky_src num-buffers=9 every=3 "
+                  "on_error=restart(5,30) name=src ! tensor_sink name=s")
+        assert st["s"]["buffers"] == 9
+        assert st["src"]["restarts"] == 3
+
+    def test_warnings_reach_the_bus(self):
+        p = parse_launch("chaos_flaky_src num-buffers=9 every=3 "
+                         "on_error=retry(3,0.01) name=src ! tensor_sink")
+        p.start()
+        p.wait_eos(timeout=30)
+        msgs = [m for m in p.bus.drain()
+                if m.kind == "warning" and m.data.get("element") == "src"]
+        p.stop()
+        assert msgs, "supervised retries must post structured warnings"
+        assert msgs[0].data.get("attempt") == 1
+        assert "cause" in msgs[0].data
+
+
+# ------------------------------------------------------ breaker (filter)
+
+class _FlakyBackend:
+    """custom-easy model whose failure window is script-controlled."""
+
+    def __init__(self):
+        self.broken = False
+        self.calls = 0
+
+    def __call__(self, x):
+        self.calls += 1
+        if self.broken:
+            raise ConnectionError("backend down")
+        return x * 2
+
+
+class TestFilterBreaker:
+    def test_open_shed_halfopen_close_cycle(self):
+        backend = _FlakyBackend()
+        register_custom_easy("chaos_breaker_model", backend)
+        p = parse_launch(
+            f'appsrc name=in caps="{CAPS_U8}" ! '
+            "tensor_filter name=f framework=custom-easy "
+            "model=chaos_breaker_model breaker-threshold=3 "
+            "breaker-reset-ms=100 ! tensor_sink name=s")
+        p.start()
+        push = lambda v: p["in"].push_buffer(  # noqa: E731
+            Buffer.from_arrays([np.full(4, v, np.uint8)]))
+        push(1)
+        deadline = time.monotonic() + 10
+        while backend.calls < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)  # appsrc delivery is async: let frame 1 land
+        backend.broken = True
+        for v in range(2, 7):  # 3 invoke failures open; 2 more are shed
+            push(v)
+        deadline = time.monotonic() + 10
+        while p["f"].stats["shed"] < 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert p["f"].stats["invoke_errors"] == 3   # shed frames never invoke
+        assert p["f"].stats["shed"] == 2
+        assert p["f"].stats["breaker_opened"] == 1
+        assert p["f"]._breaker.state == OPEN
+        backend.broken = False
+        time.sleep(0.15)  # past breaker-reset-ms: half-open
+        push(7)           # the probe: succeeds and closes the breaker
+        deadline = time.monotonic() + 10
+        while p["f"]._breaker.state != CLOSED \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert p["f"]._breaker.state == CLOSED
+        push(8)
+        p["in"].end_stream()
+        p.wait_eos(timeout=30)
+        st = p.stats()
+        p.stop()
+        # accounting: 9 pushed = 3 delivered + 3 invoke-dropped + 2 shed
+        # + 1 probe delivered -> sink saw frames 1, 7, 8
+        assert st["s"]["buffers"] == 3
+
+    def test_breaker_transition_posts_bus_warning(self):
+        backend = _FlakyBackend()
+        backend.broken = True
+        register_custom_easy("chaos_breaker_model2", backend)
+        p = parse_launch(
+            f'appsrc name=in caps="{CAPS_U8}" ! '
+            "tensor_filter name=f framework=custom-easy "
+            "model=chaos_breaker_model2 breaker-threshold=2 "
+            "breaker-reset-ms=60000 ! tensor_sink")
+        p.start()
+        for v in range(3):
+            p["in"].push_buffer(Buffer.from_arrays(
+                [np.full(4, v, np.uint8)]))
+        deadline = time.monotonic() + 10
+        while not p["f"].stats["breaker_opened"] \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        msgs = [m for m in p.bus.drain() if m.kind == "warning"
+                and m.data.get("breaker") == OPEN]
+        p["in"].end_stream()
+        p.wait_eos(timeout=30)
+        p.stop()
+        assert msgs, "breaker opening must be announced on the bus"
+        assert msgs[0].data.get("retry_after_ms") == 50.0
+
+
+# --------------------------------------------------- chaos acceptance
+
+SERVE_CAPS = ("other/tensors,format=static,num_tensors=1,"
+              "types=(string)float32,dimensions=(string)4")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+class TestServeChaos:
+    def test_seeded_chaos_run_zero_aborts_exact_accounting(self):
+        """The acceptance scenario: transient faults injected into the
+        serve pipeline's batch path while clients stream over a real
+        socket link. The run must complete with zero pipeline aborts,
+        every surviving client's frames settled (result xor shed), and
+        stats() accounting for every injected fault as a retry."""
+        register_custom_easy("chaos_serve_double", lambda x: x * 2)
+        port = _free_port()
+        server = parse_launch(
+            f"tensor_serve_src name=src port={port} id=77 buckets=1,2,4 "
+            "max-wait-ms=2 on_error=retry(3,0.01) "
+            "! tensor_fault name=fault mode=transient every=5 seed=11 "
+            "on_error=retry(3,0.01) "
+            "! tensor_filter framework=custom-easy model=chaos_serve_double "
+            "! tensor_serve_sink id=77")
+        server.start()
+        time.sleep(0.2)
+        results = {}
+
+        def run_client(tag, base, n):
+            c = parse_launch(
+                f'appsrc name=in caps="{SERVE_CAPS}" '
+                f"! tensor_query_client name=qc port={port} timeout=15 "
+                "max-request=32 ! appsink name=out")
+            c.start()
+            for i in range(n):
+                c["in"].push_buffer(Buffer.from_arrays(
+                    [np.full(4, float(base + i), np.float32)]))
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                settled = len(c["out"].buffers) + c["qc"].stats["shed"]
+                if settled >= n:
+                    break
+                time.sleep(0.05)
+            results[tag] = {
+                "got": sorted(float(b.chunks[0].host()[0])
+                              for b in c["out"].buffers),
+                "shed": c["qc"].stats["shed"],
+                "sent": n,
+            }
+            c["in"].end_stream()
+            c.stop()
+
+        # query-link fault: a fourth client submits and dies mid-flight
+        # (socket torn between submit and settle) — the link layer must
+        # absorb it without aborting or wedging the batcher
+        from nnstreamer_tpu.edge.protocol import MsgKind, buffer_to_wire, \
+            recv_msg, send_msg
+
+        def run_victim():
+            raw = socket.create_connection(("localhost", port), timeout=5)
+            send_msg(raw, MsgKind.CAPS, {"caps": SERVE_CAPS})
+            recv_msg(raw)
+            meta, payloads = buffer_to_wire(
+                Buffer.from_arrays([np.full(4, 9.0, np.float32)]))
+            for _ in range(6):
+                send_msg(raw, MsgKind.DATA, meta, payloads)
+            raw.close()  # die between submit and settle
+
+        threads = [threading.Thread(target=run_client,
+                                    args=(t, 100.0 * t, 12))
+                   for t in (1, 2, 3)] + [threading.Thread(target=run_victim)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=90)
+        st = server.stats()
+        err = server._error
+        server.stop()
+        assert err is None, f"chaos run must not abort: {err!r}"
+        for tag, r in results.items():
+            assert len(r["got"]) + r["shed"] == r["sent"], \
+                f"client {tag}: {r}"  # every frame settled exactly once
+            expected = {2.0 * (100.0 * tag + i) for i in range(12)}
+            assert set(r["got"]) <= expected  # each result is ITS frame, x2
+        # exact fault accounting: every injected transient was retried
+        assert st["fault"]["faults"] > 0
+        assert st["fault"]["retries"] == st["fault"]["faults"]
+        assert st["fault"]["dropped"] == 0
+
+    def test_same_schedule_under_fail_policy_aborts(self):
+        """Control arm: the identical fault schedule with the default
+        ``fail`` policy reproduces the historical pipeline abort."""
+        register_custom_easy("chaos_serve_double", lambda x: x * 2)
+        port = _free_port()
+        # buckets=1: every frame is its own batch, so the every-N fault
+        # schedule is deterministic in frames, not in batch shapes
+        server = parse_launch(
+            f"tensor_serve_src name=src port={port} id=78 buckets=1 "
+            "max-wait-ms=1 "
+            "! tensor_fault mode=transient every=4 seed=11 "
+            "! tensor_filter framework=custom-easy model=chaos_serve_double "
+            "! tensor_serve_sink id=78")
+        server.start()
+        time.sleep(0.2)
+        client = parse_launch(
+            f'appsrc name=in caps="{SERVE_CAPS}" '
+            f"! tensor_query_client name=qc port={port} timeout=5 "
+            "max-request=32 ! appsink name=out")
+        client.start()
+        for i in range(12):
+            client["in"].push_buffer(Buffer.from_arrays(
+                [np.full(4, float(i), np.float32)]))
+        deadline = time.monotonic() + 30
+        while server._error is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+        err = server._error
+        client["in"].end_stream()
+        client.stop()
+        server.stop()
+        assert isinstance(err, FaultInjected), \
+            f"fail policy must abort the pipeline, got {err!r}"

@@ -348,6 +348,53 @@ class TestServeElements:
         assert rep["completed"] + rep["cancelled"] >= 6
         assert server["src"].scheduler.batcher.depth() == 0
 
+    def test_mid_stream_death_batch_settles_for_survivors(self):
+        """A client killed BETWEEN submit and settle (its request is
+        already admitted, possibly co-batched with a survivor's) must
+        not abort the batch: the scheduler reclaims what was still
+        queued, the reply path books the dead connection instead of
+        raising, and every surviving client's frames settle."""
+        from nnstreamer_tpu.edge.protocol import MsgKind, buffer_to_wire, \
+            recv_msg, send_msg
+        port = _free_port()
+        server = parse_launch(
+            f'tensor_serve_src name=src port={port} id=44 buckets=1,2 '
+            'max-wait-ms=20 max-queue=16 '
+            '! tensor_filter framework=custom-easy model=serve_slow '
+            '! tensor_serve_sink id=44')
+        server.start()
+        time.sleep(0.2)
+        # victim: raw socket, handshake + burst, then dies mid-flight —
+        # after the submits are admitted but before any result lands
+        raw = socket.create_connection(("localhost", port), timeout=5)
+        send_msg(raw, MsgKind.CAPS, {"caps": CAPS4})
+        recv_msg(raw)
+        meta, payloads = buffer_to_wire(
+            Buffer.from_arrays([np.full(4, 9.0, np.float32)]))
+        # survivor submits concurrently so some batches mix both streams
+        client = parse_launch(
+            f'appsrc name=in caps="{CAPS4}" '
+            f'! tensor_query_client port={port} timeout=15 '
+            'max-request=16 ! appsink name=out')
+        client.start()
+        for i in range(8):
+            send_msg(raw, MsgKind.DATA, meta, payloads)
+            client["in"].push_buffer(Buffer.from_arrays(
+                [np.full(4, float(i), np.float32)]))
+        raw.close()  # die between submit and settle
+        out = _push_and_wait(client, [], 8)
+        rep = server["src"].scheduler.report()
+        depth = server["src"].scheduler.batcher.depth()
+        client["in"].end_stream()
+        client.stop()
+        server.stop()
+        assert sorted(out) == [float(i) for i in range(8)]  # survivors whole
+        # the victim's 8 frames are fully accounted: completed before
+        # the close was noticed, or reclaimed from the queue
+        assert rep["completed"] + rep["cancelled"] + rep["shed_admission"] \
+            >= 16
+        assert depth == 0  # nothing left wedged in the batcher
+
     def test_jit_cache_bounded_by_buckets(self):
         """The acceptance bound: across ragged concurrency the jax jit
         cache holds at most len(buckets) compiled signatures, because
