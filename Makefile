@@ -9,7 +9,7 @@ SHELL := /bin/bash
 LIB := $(BUILD)/libnnstpu.so
 EXAMPLES := $(BUILD)/custom_passthrough.so $(BUILD)/custom_scaler.so
 
-.PHONY: native clean test check tier1 lint chaos package
+.PHONY: native clean test check tier1 lint racecheck chaos package
 
 native: $(LIB) $(EXAMPLES)
 
@@ -17,7 +17,7 @@ native: $(LIB) $(EXAMPLES)
 # non-slow test suite on the 8-virtual-device CPU mesh
 # (tests/conftest.py forces JAX_PLATFORMS=cpu) + a packaging sanity
 # check.
-check: native lint
+check: native lint racecheck
 	python -m pytest tests/ -q -m 'not slow'
 	python -c "import nnstreamer_tpu as nt; print('import ok:', len(nt.pipeline.registry.element_names()), 'elements')"
 	$(MAKE) chaos
@@ -32,6 +32,13 @@ chaos:
 # (timeout, log tee, pass-dot count and all).
 tier1:
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
+
+# `make racecheck` = the concurrency gate: the package's own sources
+# must carry no lockset / lock-order / blocking-under-lock findings
+# (deliberate, reasoned suppressions excepted). The JSON report lands
+# in build/racecheck.json for CI artifacts.
+racecheck:
+	env JAX_PLATFORMS=cpu python -m nnstreamer_tpu racecheck nnstreamer_tpu -o build/racecheck.json
 
 # `make lint` = static gates: bytecode-compile the package, then run
 # pipelint over every pipeline description in tests/ and README.md

@@ -15,6 +15,11 @@ Static analysis (pipelint)::
 
     python -m nnstreamer_tpu lint 'tensortestsrc ... ! fakesink'
     python -m nnstreamer_tpu lint --json '<desc>'   # exit 0/1/2
+
+Concurrency analysis (racecheck)::
+
+    python -m nnstreamer_tpu racecheck nnstreamer_tpu/
+    python -m nnstreamer_tpu racecheck --json -o build/racecheck.json
 """
 from __future__ import annotations
 
@@ -93,6 +98,9 @@ def main(argv=None) -> int:
     if argv and argv[0] == "lint":
         from .analysis.cli import main as lint_main
         return lint_main(argv[1:])
+    if argv and argv[0] == "racecheck":
+        from .analysis.concurrency.cli import main as racecheck_main
+        return racecheck_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="python -m nnstreamer_tpu",
         description="Launch a tensor pipeline (gst-launch analog).")

@@ -139,7 +139,7 @@ class _CollectBase(Element):
     # -- dataflow ---------------------------------------------------------
     def chain(self, pad: Pad, item) -> None:
         if isinstance(item, Event):
-            self.stats["events"] += 1
+            self.stats.inc("events")
             self.handle_event(pad, item)
             return
         with self._lock:

@@ -187,7 +187,7 @@ class EdgeSrc(SrcElement):
         except (ConnectionError, OSError) as exc:
             logger.warning("%s: reconnect failed: %s", self.name, exc)
             return False
-        self.stats["reconnects"] += 1
+        self.stats.inc("reconnects")
         self.post_message("warning", reconnects=self.stats["reconnects"],
                           detail="publisher link re-established")
         return True
@@ -199,7 +199,7 @@ class EdgeSrc(SrcElement):
             except (ConnectionError, OSError) as exc:
                 if self._stop_evt.is_set():
                     return None
-                self.stats["link_errors"] += 1
+                self.stats.inc("link_errors")
                 logger.info("%s: publisher link lost (%r)", self.name, exc)
                 if self.reconnect and self._reconnect():
                     continue

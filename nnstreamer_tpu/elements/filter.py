@@ -351,7 +351,7 @@ class TensorFilter(Element):
             # downstream can't keep up: skip the invoke entirely so the
             # accelerator does no wasted work (≙ throttling check,
             # tensor_filter.c:532-584)
-            self.stats["qos_dropped"] += 1
+            self.stats.inc("qos_dropped")
             return
         if self._breaker is not None and not self._breaker.allow():
             # breaker OPEN: the backend is currently only producing
@@ -379,7 +379,7 @@ class TensorFilter(Element):
             # A deliberate drop is a WORKING backend for the breaker.
             if self._breaker is not None:
                 self._breaker.record_success()
-            self.stats["frames_dropped"] += 1
+            self.stats.inc("frames_dropped")
             return
         except Exception as exc:  # noqa: BLE001
             # invoke failure drops THIS frame but keeps the pipeline alive
@@ -389,8 +389,8 @@ class TensorFilter(Element):
             # so a permanently broken model can't flood an unread bus, and
             # carry the message string only — holding the exception object
             # would pin the traceback (and the input tensors) in memory.
-            n = self.stats["invoke_errors"] = self.stats["invoke_errors"] + 1
-            self.stats["frames_dropped"] += 1
+            n = self.stats.inc("invoke_errors")
+            self.stats.inc("frames_dropped")
             if self._breaker is not None:
                 self._breaker.record_failure()
             logger.warning("%s: invoke failed (frame dropped, pipeline "
@@ -440,8 +440,8 @@ class TensorFilter(Element):
         get their on_shed callback (the wire-level SHED + retry-after
         reply), and upstream gets a QosEvent spaced by the retry-after
         hint so sources stop producing doomed frames."""
-        self.stats["shed"] += 1
-        self.stats["dropped"] += 1
+        self.stats.inc("shed")
+        self.stats.inc("dropped")
         retry_after_ms = float(self.breaker_retry_after_ms)
         rows = buf.extras.get("serve_rows")
         if rows:
@@ -460,7 +460,7 @@ class TensorFilter(Element):
     def _on_breaker_transition(self, old: str, new: str) -> None:
         from ..fault.breaker import OPEN
         if new == OPEN:
-            self.stats["breaker_opened"] += 1
+            self.stats.inc("breaker_opened")
         logger.warning("%s: circuit breaker %s -> %s", self.name, old, new)
         self.post_message("warning", breaker=new, breaker_from=old,
                           invoke_errors=self.stats["invoke_errors"],

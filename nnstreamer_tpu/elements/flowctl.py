@@ -217,9 +217,9 @@ class TensorRate(TransformElement):
 
     def transform(self, buf: Buffer) -> Optional[Buffer]:
         tgt = self._target()
-        self.stats["in"] += 1
+        self.stats.inc("in")
         if tgt is None or buf.pts is None:
-            self.stats["out"] += 1
+            self.stats.inc("out")
             return buf
         period = int(1e9 * tgt[1] / tgt[0])
         if self._next_ts is None:
@@ -228,7 +228,7 @@ class TensorRate(TransformElement):
                     if self._last_in_pts is not None else None)
         self._last_in_pts = buf.pts
         if buf.pts < self._next_ts:
-            self.stats["drop"] += 1
+            self.stats.inc("drop")
             self._prev = buf
             if self.throttle and not self._throttling:
                 # upstream is overproducing: ask producers (tensor_filter
@@ -253,13 +253,12 @@ class TensorRate(TransformElement):
         while self._prev is not None and buf.pts >= self._next_ts + period:
             dup = self._prev.with_chunks(self._prev.chunks)
             dup.pts, dup.duration = self._next_ts, period
-            self.stats["dup"] += 1
-            self.stats["out"] += 1
+            self.stats.add(dup=1, out=1)
             self.push(dup)
             self._next_ts += period
         out = buf.with_chunks(buf.chunks)
         out.pts, out.duration = self._next_ts, period
         self._next_ts += period
         self._prev = buf
-        self.stats["out"] += 1
+        self.stats.inc("out")
         return out

@@ -159,7 +159,7 @@ class MqttSink(SinkElement):
         cap = max(1, int(self.max_backlog))
         while len(self._q1_backlog) > cap:
             self._q1_backlog.pop(0)
-            self.stats["backlog_dropped"] += 1
+            self.stats.inc("backlog_dropped")
         if self._client is None and time.monotonic() < self._next_reconnect:
             return  # back off: let frames queue without a connect stall
         for _attempt in range(2):
@@ -246,7 +246,7 @@ class MqttSrc(SrcElement):
                             self.name, exc)
                 backoff.sleep(self._stop_evt)
                 continue
-            self.stats["reconnects"] += 1
+            self.stats.inc("reconnects")
             self.post_message("warning",
                               reconnects=self.stats["reconnects"],
                               detail="broker link re-established")
@@ -286,7 +286,7 @@ class MqttSrc(SrcElement):
             except (ConnectionError, OSError, ValueError) as exc:
                 if self._stop_evt.is_set():
                     return None
-                self.stats["link_errors"] += 1
+                self.stats.inc("link_errors")
                 logger.info("%s: broker link lost (%r)", self.name, exc)
                 if self.reconnect and self._reconnect():
                     continue

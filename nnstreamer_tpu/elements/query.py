@@ -215,7 +215,7 @@ class TensorQueryServerSrc(SrcElement):
             # a dying client is routine, but never silent: the cause is
             # logged and counted so a flapping link is diagnosable from
             # stats() instead of invisible
-            self.stats["link_errors"] += 1
+            self.stats.inc("link_errors")
             logger.info("%s: client %d connection ended: %r",
                         self.name, cid, exc)
         finally:
@@ -396,7 +396,11 @@ class TensorQueryClient(Element):
         """(Re)connect: discovery + handshake + pending replay, retried
         with backoff until ``timeout``. Each retry re-discovers, so a
         replacement server registered after a death is found."""
-        self._last_caps = caps or self._last_caps
+        # both the chain thread (do_chain -> _connect) and the background
+        # reconnect thread write this; _conn_lock keeps the read-modify-
+        # write whole
+        with self._conn_lock:
+            self._last_caps = caps or self._last_caps
         with self._connect_mutex:
             if self._sock is not None:
                 return  # lost the race: another thread reconnected
@@ -420,6 +424,7 @@ class TensorQueryClient(Element):
                             return
                 except (ConnectionError, OSError) as e:
                     last_err = e
+                # racecheck: ok(deliberate: reconnects are serialized under _connect_mutex, the sleep is stop-interruptible and deadline-budgeted)
                 backoff.sleep(self._stop_evt)
             raise ConnectionError(
                 f"{self.name}: cannot reach a query server: {last_err}")
@@ -506,7 +511,8 @@ class TensorQueryClient(Element):
     def do_chain(self, pad: Pad, buf: Buffer) -> None:
         meta, payloads = buffer_to_wire(buf)
         meta["seq"] = self._seq = self._seq + 1
-        self._last_caps = pad.caps or self._last_caps
+        with self._conn_lock:
+            self._last_caps = pad.caps or self._last_caps
         entry = [meta, payloads, -1]  # -1 = not yet sent on any connection
         with self._plock:
             self._pending.append(entry)
@@ -515,7 +521,7 @@ class TensorQueryClient(Element):
             try:
                 if self._sock is None:
                     self._connect(pad.caps)
-                    self.stats["reconnects"] += 1
+                    self.stats.inc("reconnects")
                     self.set_src_caps(Caps(self._server_caps))
                 with self._conn_lock:
                     sock, gen = self._sock, self._conn_gen
@@ -594,7 +600,7 @@ class TensorQueryClient(Element):
                         # deadline): no result will come. Surface the
                         # overload upstream as QoS with the server's
                         # retry-after as the sustainable spacing hint.
-                        self.stats["shed"] += 1
+                        self.stats.inc("shed")
                         retry_ns = int(
                             float(meta.get("retry_after_ms", 0.0)) * 1e6)
                         self.send_upstream_event(QosEvent(
@@ -625,7 +631,7 @@ class TensorQueryClient(Element):
     def _reconnect_bg(self) -> None:
         try:
             self._connect(self._last_caps)
-            self.stats["reconnects"] += 1
+            self.stats.inc("reconnects")
         except (ConnectionError, OSError) as e:
             logger.warning("%s: background reconnect failed: %s",
                            self.name, e)

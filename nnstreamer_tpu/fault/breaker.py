@@ -22,6 +22,8 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ..utils.atomic import Counters
+
 CLOSED = "closed"
 OPEN = "open"
 HALF_OPEN = "half-open"
@@ -40,7 +42,7 @@ class CircuitBreaker:
         self._consecutive = 0
         self._opened_at = 0.0
         self._probe_inflight = False
-        self.stats = {"opened": 0, "closed": 0, "rejected": 0}
+        self.stats = Counters(opened=0, closed=0, rejected=0)
 
     @property
     def state(self) -> str:
@@ -51,10 +53,10 @@ class CircuitBreaker:
     def _transition_locked(self, new: str) -> None:
         old, self._state = self._state, new
         if new == OPEN:
-            self.stats["opened"] += 1
+            self.stats.inc("opened")
             self._opened_at = time.monotonic()
         elif new == CLOSED:
-            self.stats["closed"] += 1
+            self.stats.inc("closed")
         cb = self._on_transition
         if cb is not None and old != new:
             # called under the lock: transitions are strictly ordered and
@@ -77,7 +79,7 @@ class CircuitBreaker:
             if self._state == HALF_OPEN and not self._probe_inflight:
                 self._probe_inflight = True
                 return True
-            self.stats["rejected"] += 1
+            self.stats.inc("rejected")
             return False
 
     def record_success(self) -> None:

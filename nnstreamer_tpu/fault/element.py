@@ -77,9 +77,9 @@ class TensorFault(TransformElement):
 
     def transform(self, buf: Buffer) -> Optional[Buffer]:
         if not self._should_fire():
-            self.stats["passed"] += 1
+            self.stats.inc("passed")
             return buf
-        n = self.stats["faults"] = self.stats["faults"] + 1
+        n = self.stats.inc("faults")
         mode = str(self.mode)
         if mode == "raise":
             raise RuntimeError(
@@ -102,7 +102,7 @@ class TensorFault(TransformElement):
                                   list(buf.chunks[1:]))
             return out
         if mode == "drop":
-            self.stats["dropped"] += 1
+            self.stats.inc("dropped")
             return None
         raise ValueError(f"{self.name}: unknown mode {mode!r} "
                          f"(expected one of {_MODES})")

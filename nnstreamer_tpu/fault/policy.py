@@ -155,7 +155,7 @@ def handle_chain_error(element, pad, buf, exc: Exception) -> bool:
     """
     policy = policy_of(element)
     if policy.action == "skip":
-        n = element.stats["dropped"] = element.stats["dropped"] + 1
+        n = element.stats.inc("dropped")
         logger.warning("%s: buffer skipped by on-error=skip (%s)",
                        element.name, exc)
         _warn_rate_limited(element, n, policy="skip", dropped=n,
@@ -170,7 +170,7 @@ def handle_chain_error(element, pad, buf, exc: Exception) -> bool:
         stop_evt = getattr(element, "_stop_evt", None)
         for attempt in range(1, policy.max_retries + 1):
             backoff.sleep(stop_evt)
-            element.stats["retries"] += 1
+            element.stats.inc("retries")
             _warn_rate_limited(element, element.stats["retries"],
                                policy="retry", attempt=attempt,
                                cause=repr(exc))
@@ -196,7 +196,7 @@ def handle_chain_error(element, pad, buf, exc: Exception) -> bool:
                      attempts=budget.limit,
                      detail=f"restart budget exhausted "
                             f"({budget.limit}/{policy.window_s:g}s)")
-        element.stats["restarts"] += 1
+        element.stats.inc("restarts")
         element.post_message("warning", policy="restart",
                              attempt=element.stats["restarts"],
                              cause=repr(exc))

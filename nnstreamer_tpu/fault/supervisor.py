@@ -57,8 +57,7 @@ class Supervisor:
             return ESCALATE  # stopping: don't retry into a torn-down world
 
         if action == "skip":
-            n = self.element.stats["dropped"] = \
-                self.element.stats["dropped"] + 1
+            n = self.element.stats.inc("dropped")
             logger.warning("%s: %s failure skipped by on-error=skip (%s)",
                            self.element.name, where, exc)
             self._post_warning(policy="skip", where=where, dropped=n,
@@ -70,7 +69,7 @@ class Supervisor:
                     or self._consecutive > self.policy.max_retries:
                 return ESCALATE
             delay = self.backoff.sleep(stop_evt)
-            self.element.stats["retries"] += 1
+            self.element.stats.inc("retries")
             self._post_warning(policy="retry", where=where,
                                attempt=self._consecutive,
                                backoff_s=round(delay, 4), cause=repr(exc))
@@ -83,7 +82,7 @@ class Supervisor:
             if not self.budget.allow():
                 return ESCALATE
             delay = self.backoff.sleep(stop_evt)
-            self.element.stats["restarts"] += 1
+            self.element.stats.inc("restarts")
             self._post_warning(policy="restart", where=where,
                                attempt=self.element.stats["restarts"],
                                backoff_s=round(delay, 4), cause=repr(exc))

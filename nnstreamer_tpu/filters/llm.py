@@ -27,6 +27,7 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from ..tensors.info import TensorsInfo
+from ..utils.atomic import Counters
 from ..utils.log import logger
 from .base import (FilterFramework, FilterProperties,
                    parse_custom_properties as _parse_custom)
@@ -134,8 +135,8 @@ class LlmFilter(FilterFramework):
         # ACTUAL weight-reading steps executed (a chunked dispatch runs
         # an adaptive k <= chunk of them) — the honest multiplier for
         # decode bandwidth accounting.
-        self.stats = {"prefill_dispatches": 0, "decode_dispatches": 0,
-                      "decode_steps": 0}
+        self.stats = Counters(prefill_dispatches=0, decode_dispatches=0,
+                              decode_steps=0)
 
     def close(self) -> None:
         self._stop.set()
@@ -185,7 +186,7 @@ class LlmFilter(FilterFramework):
         logits, cache = self._prefill(
             self._params, cache, jnp.asarray(padded[None, :]),
             jnp.asarray(prompt.size, jnp.int32))
-        self.stats["prefill_dispatches"] += 1
+        self.stats.inc("prefill_dispatches")
         return logits, cache
 
     def _sampling(self):
@@ -254,8 +255,7 @@ class LlmFilter(FilterFramework):
                 return  # nothing left to decode: skip the trailing step
             logits, cache = self._decode(self._params, cache,
                                          tok.astype(jnp.int32))
-            self.stats["decode_dispatches"] += 1
-            self.stats["decode_steps"] += 1
+            self.stats.add(decode_dispatches=1, decode_steps=1)
             pos += 1
 
     def _generate_chunked(self, logits, cache, pos, max_tokens, max_len,
@@ -287,8 +287,7 @@ class LlmFilter(FilterFramework):
                 return
             toks, logits, mcache, keys = self._chunk_fn(k, temperature)(
                 self._params, mcache, logits, keys, active)
-            self.stats["decode_dispatches"] += 1
-            self.stats["decode_steps"] += k
+            self.stats.add(decode_dispatches=1, decode_steps=k)
             toks_host = np.asarray(toks)  # ONE fetch for k tokens
             for j in range(k):
                 emit(toks_host[j].astype(np.int32))
@@ -430,8 +429,7 @@ class LlmFilter(FilterFramework):
             if active_np.any():
                 logits, cache = self._decode_multi(
                     self._params, cache, tok, jnp.asarray(active_np))
-                self.stats["decode_dispatches"] += 1
-                self.stats["decode_steps"] += 1
+                self.stats.add(decode_dispatches=1, decode_steps=1)
 
     def _sched_chunk(self, streams, active_np, logits, cache, max_len,
                      temperature):
@@ -467,8 +465,7 @@ class LlmFilter(FilterFramework):
             keys = jnp.zeros((len(streams), 2), jnp.uint32)
         toks, logits, cache, keys = self._chunk_fn(k, temperature)(
             self._params, cache, logits, keys, jnp.asarray(active_np))
-        self.stats["decode_dispatches"] += 1
-        self.stats["decode_steps"] += k
+        self.stats.add(decode_dispatches=1, decode_steps=k)
         toks_host = np.asarray(toks)  # [k, M]: ONE fetch for the chunk
         for slot, s in enumerate(streams):
             if s is None:

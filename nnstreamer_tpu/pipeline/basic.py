@@ -203,8 +203,7 @@ class Queue(Element):
                     else:
                         self.forward_event(item)
                 else:
-                    self.stats["buffers"] += 1
-                    self.stats["bytes"] += item.nbytes
+                    self.stats.add(buffers=1, bytes=item.nbytes)
                     self.srcpad.push(item)
             except FlowError:
                 break

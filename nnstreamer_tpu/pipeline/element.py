@@ -18,6 +18,7 @@ from typing import Any, Dict, List, Optional, Union
 
 from ..tensors.buffer import Buffer
 from ..tensors.caps import Caps
+from ..utils.atomic import Counters
 from ..utils.log import logger
 from .events import (CapsEvent, EosEvent, Event, FlushEvent, QosEvent,
                      SegmentEvent, StreamStart)
@@ -85,12 +86,15 @@ class Element:
         self.src_pads: Dict[str, Pad] = {}
         self._eos_seen: set = set()
         self._started = False
-        self.stats = {"buffers": 0, "bytes": 0, "proctime_ns": 0,
-                      "events": 0,
-                      # fault-policy accounting (fault/policy.py): how
-                      # many buffers were skipped/shed, retried, and how
-                      # often the element was bounced by on-error=restart
-                      "dropped": 0, "retries": 0, "restarts": 0}
+        # atomic counter map: chain threads, the fault supervisor, and
+        # network reader threads all mutate these while Pipeline.stats()
+        # and trace.report() read them from the user thread
+        self.stats = Counters({"buffers": 0, "bytes": 0, "proctime_ns": 0,
+                               "events": 0,
+                               # fault-policy accounting (fault/policy.py):
+                               # buffers skipped/shed, retried, and how
+                               # often on-error=restart bounced the element
+                               "dropped": 0, "retries": 0, "restarts": 0})
         # merged property table from the full class hierarchy
         self._prop_defaults: Dict[str, Any] = {}
         for klass in reversed(type(self).__mro__):
@@ -176,7 +180,7 @@ class Element:
     def chain(self, pad: Pad, item: Union[Buffer, Event]) -> None:
         """Entry point for data arriving on a sink pad."""
         if isinstance(item, Event):
-            self.stats["events"] += 1
+            self.stats.inc("events")
             self.handle_event(pad, item)
             return
         tracer = getattr(self.pipeline, "tracer", None)
@@ -195,9 +199,8 @@ class Element:
             if not handle_chain_error(self, pad, item, exc):
                 return  # buffer consumed by the policy (skipped)
         dt = time.perf_counter_ns() - t0
-        self.stats["buffers"] += 1
-        self.stats["bytes"] += item.nbytes
-        self.stats["proctime_ns"] += dt
+        # one lock round-trip for the whole per-buffer bump
+        self.stats.add(buffers=1, bytes=item.nbytes, proctime_ns=dt)
 
     def do_chain(self, pad: Pad, buf: Buffer) -> None:
         raise NotImplementedError

@@ -178,7 +178,10 @@ class Pipeline:
 
     # -- introspection ----------------------------------------------------
     def stats(self) -> Dict[str, Dict[str, Any]]:
-        return {name: dict(e.stats) for name, e in self.elements.items()}
+        """Per-element counter snapshots, each internally consistent
+        (taken under the element's Counters lock)."""
+        return {name: e.stats.snapshot()
+                for name, e in self.elements.items()}
 
     def __repr__(self) -> str:
         return f"<Pipeline {self.name!r} elements={list(self.elements)}>"

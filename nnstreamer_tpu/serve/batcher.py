@@ -26,6 +26,8 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..utils.atomic import Counters
+
 
 class Request:
     """One in-flight inference request with its reply route.
@@ -85,8 +87,8 @@ class BucketBatcher:
         self._cond = threading.Condition()
         self._fifo: Deque[Request] = deque()
         self._per_stream: Dict[Any, int] = {}
-        self.stats = {"submitted": 0, "batches": 0, "shed_admission": 0,
-                      "shed_deadline": 0, "cancelled": 0}
+        self.stats = Counters(submitted=0, batches=0, shed_admission=0,
+                              shed_deadline=0, cancelled=0)
 
     # -- producers ---------------------------------------------------------
     def submit(self, req: Request) -> bool:
@@ -97,11 +99,11 @@ class BucketBatcher:
         with self._cond:
             n = self._per_stream.get(req.stream_id, 0)
             if n >= self.max_queue:
-                self.stats["shed_admission"] += 1
+                self.stats.inc("shed_admission")
                 return False
             self._per_stream[req.stream_id] = n + 1
             self._fifo.append(req)
-            self.stats["submitted"] += 1
+            self.stats.inc("submitted")
             self._cond.notify_all()
         return True
 
@@ -113,7 +115,7 @@ class BucketBatcher:
             n = len(self._fifo) - len(kept)
             self._fifo = deque(kept)
             self._per_stream.pop(stream_id, None)
-            self.stats["cancelled"] += n
+            self.stats.inc("cancelled", n)
         return n
 
     def depth(self, stream_id: Any = None) -> int:
@@ -159,7 +161,7 @@ class BucketBatcher:
                             else:
                                 self._per_stream[r.stream_id] = n
                             r.t_batched = now
-                        self.stats["batches"] += 1
+                        self.stats.inc("batches")
                         return batch
                     timeout = flush_at - now
                     nearest = min((r.deadline for r in self._fifo
@@ -188,7 +190,7 @@ class BucketBatcher:
             else:
                 kept.append(r)
         self._fifo = deque(kept)
-        self.stats["shed_deadline"] += len(out)
+        self.stats.inc("shed_deadline", len(out))
 
     def _stackable_run(self, cap: int) -> int:
         """Length of the stackable run at the head of the FIFO: requests

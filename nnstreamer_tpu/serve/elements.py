@@ -157,7 +157,7 @@ class TensorServeSrc(SrcElement):
         except (ConnectionError, OSError, ValueError) as exc:
             # routine client death, but logged + counted (never a bare
             # discard): flapping clients must show up in stats()
-            self.stats["link_errors"] += 1
+            self.stats.inc("link_errors")
             logger.info("%s: client %d connection ended: %r",
                         self.name, cid, exc)
         finally:

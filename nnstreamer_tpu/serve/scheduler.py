@@ -25,6 +25,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..utils.atomic import Counters
 from ..utils.log import logger
 from ..utils.trace import Reservoir
 from .batcher import BucketBatcher, Request, stack_requests
@@ -65,8 +66,8 @@ class ServeScheduler:
         self._mlock = threading.Lock()
         self._queue_delay = Reservoir()
         self._batch_latency = Reservoir()
-        self.stats = {"completed": 0, "rows_padded": 0, "bucket_rows": 0,
-                      "result_errors": 0, "invoke_errors": 0}
+        self.stats = Counters(completed=0, rows_padded=0, bucket_rows=0,
+                              result_errors=0, invoke_errors=0)
 
     # -- producers ---------------------------------------------------------
     def submit(self, stream_id: Any, arrays: Sequence[Any], *,
@@ -103,8 +104,7 @@ class ServeScheduler:
         with self._mlock:
             for r in batch:
                 self._queue_delay.add((now - r.t_arrival) * 1e9)
-            self.stats["bucket_rows"] += bucket
-            self.stats["rows_padded"] += bucket - len(batch)
+            self.stats.add(bucket_rows=bucket, rows_padded=bucket - len(batch))
         if self.tracer is not None:
             for r in batch:
                 self.tracer.observe(f"{self.name}:queue_delay",
@@ -133,20 +133,20 @@ class ServeScheduler:
                 req.on_result(req, row)
             except Exception:  # noqa: BLE001 — one dead client, not a batch
                 with self._mlock:
-                    self.stats["result_errors"] += 1
+                    self.stats.inc("result_errors")
                 logger.warning("%s: result callback failed for stream %s",
                                self.name, req.stream_id, exc_info=True)
         with self._mlock:
-            self.stats["completed"] += len(batch)
+            self.stats.inc("completed", len(batch))
 
     # -- metrics -----------------------------------------------------------
     def report(self) -> Dict[str, Any]:
         """Occupancy, queue delay and batch latency percentiles, shed
         counts — the per-batch observability the ISSUE's serving stack
         promises (also mirrored into an attached Tracer)."""
-        b = dict(self.batcher.stats)
+        b = self.batcher.stats.snapshot()
         with self._mlock:
-            s = dict(self.stats)
+            s = self.stats.snapshot()
             qd = self._queue_delay.percentiles()
             bl = self._batch_latency.percentiles()
         filled = s["bucket_rows"] - s["rows_padded"]
@@ -195,7 +195,7 @@ class ServeScheduler:
                 outputs = self._invoke_fn(stacked)
             except Exception as exc:  # noqa: BLE001 — shed the batch, keep serving
                 with self._mlock:
-                    self.stats["invoke_errors"] += 1
+                    self.stats.inc("invoke_errors")
                 logger.warning("%s: invoke failed (%r), batch of %d shed",
                                self.name, exc, len(batch), exc_info=True)
                 for r in batch:

@@ -170,6 +170,7 @@ class Buffer:
         return iter(self.chunks)
 
     def append(self, chunk: Chunk) -> None:
+        # racecheck: ok(buffers are single-owner: built by one thread, then handed off whole via queue/pad push)
         self.chunks.append(chunk)
 
     @property

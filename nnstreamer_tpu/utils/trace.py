@@ -155,7 +155,9 @@ class Tracer:
         if pipeline is not None:
             for name, el in pipeline.elements.items():
                 entry = out.setdefault(name, {})
-                st = el.stats
+                # one consistent point-in-time copy per element: a
+                # mid-flight chain bump can't tear buffers/proctime
+                st = el.stats.snapshot()
                 if st.get("buffers"):
                     entry["proctime_us_avg"] = (st["proctime_ns"] /
                                                 st["buffers"] / 1e3)

@@ -644,3 +644,65 @@ class TestServeChaos:
         server.stop()
         assert isinstance(err, FaultInjected), \
             f"fail policy must abort the pipeline, got {err!r}"
+
+
+# ------------------------------------------------- runtime lock validator
+
+class TestRuntimeLockValidator:
+    def test_chaos_breaker_path_matches_static_graph(self):
+        """Run the breaker open/shed/close cycle with the breaker's lock
+        and every element's counters instrumented; the recorded
+        acquisition graph must be acyclic and a subset of racecheck's
+        static lock-order graph."""
+        from pathlib import Path
+
+        import nnstreamer_tpu
+        from nnstreamer_tpu.analysis.concurrency import (
+            LockMonitor, analyze_paths, instrument_counters,
+            instrument_object)
+
+        backend = _FlakyBackend()
+        register_custom_easy("chaos_racecheck_model", backend)
+        p = parse_launch(
+            f'appsrc name=in caps="{CAPS_U8}" ! '
+            "tensor_filter name=f framework=custom-easy "
+            "model=chaos_racecheck_model breaker-threshold=3 "
+            "breaker-reset-ms=100 ! tensor_sink name=s")
+        mon = LockMonitor()
+        p.start()
+        # the breaker is built by the filter's open hook, so instrument
+        # right after start — before any frame flows
+        instrument_object(p["f"]._breaker, mon)      # CircuitBreaker._lock
+        instrument_counters(p["f"]._breaker.stats, mon)
+        for el in p.elements.values():
+            instrument_counters(el.stats, mon)
+
+        push = lambda v: p["in"].push_buffer(  # noqa: E731
+            Buffer.from_arrays([np.full(4, v, np.uint8)]))
+        push(1)
+        deadline = time.monotonic() + 10
+        while backend.calls < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        backend.broken = True
+        for v in range(2, 7):  # 3 invoke failures open; 2 more are shed
+            push(v)
+        deadline = time.monotonic() + 10
+        while p["f"].stats["shed"] < 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        backend.broken = False
+        time.sleep(0.15)  # past breaker-reset-ms: half-open
+        push(7)           # the probe closes the breaker again
+        p["in"].end_stream()
+        p.wait_eos(timeout=30)
+        p.stop()
+        assert p["f"]._breaker.stats["opened"] == 1
+        assert p["f"]._breaker.stats["closed"] == 1
+
+        assert mon.acquisitions, "instrumented locks were never taken"
+        pkg = Path(nnstreamer_tpu.__file__).parent
+        static = analyze_paths([str(pkg)]).lock_edges
+        cycles, missed = mon.check_against_static(static)
+        assert cycles == [], f"runtime witnessed a deadlockable order: {cycles}"
+        assert missed == set(), f"static graph missed edges: {missed}"
+        # breaker transitions bump their counters under the breaker lock
+        assert ("CircuitBreaker._lock", "Counters._lock") in mon.edge_set()

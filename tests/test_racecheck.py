@@ -1,0 +1,510 @@
+"""racecheck: static concurrency analyzer over un-executed sources.
+
+Seeds one fixture module per defect class and asserts the analyzer
+reports the right rule at the right ``file:line`` — without importing,
+let alone running, the fixture code. Mirrors test_analysis.py: defect
+corpus + clean corpus + CLI exit-code contract (0 clean / 1 findings /
+2 usage error).
+"""
+import json
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from nnstreamer_tpu.analysis.concurrency import (BLOCKING_UNDER_LOCK,
+                                                 LOCK_ORDER_CYCLE,
+                                                 SLEEP_UNDER_LOCK,
+                                                 UNGUARDED_WRITE,
+                                                 analyze_paths, find_cycles)
+from nnstreamer_tpu.analysis.concurrency.cli import main as racecheck_main
+
+PACKAGE_DIR = Path(__file__).resolve().parents[1] / "nnstreamer_tpu"
+
+
+def check(tmp_path, source, name="fixture.py", rule=None):
+    """Write one fixture module, scan it, return (findings, report)."""
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(source))
+    report = analyze_paths([str(f)])
+    if rule is None:
+        return report.findings, report
+    return report.by_rule(rule), report
+
+
+# --------------------------------------------------------------- fixtures
+# Module-level constants carry NO base indentation so line numbers in the
+# written file match the literal, and targeted str.replace stays honest.
+
+UNGUARDED = """\
+import threading
+
+class Element:      # role seed: Element.chain runs on the chain thread
+    pass
+
+class BadCounter(Element):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def chain(self, pad, buf):
+        self.count += 1            # line 12: chain-thread rmw, no lock
+
+    def flush(self):
+        self.count = 0             # user thread writes too: second role
+"""
+
+INVERSION = """\
+import threading
+
+class Inverted:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self):
+        with self._a:
+            with self._b:          # A -> B
+                pass
+
+    def backward(self):
+        with self._b:
+            with self._a:          # B -> A: deadlockable
+                pass
+"""
+
+SLEEPY = """\
+import threading
+import time
+
+class Sleepy:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def poll(self):
+        with self._lock:
+            time.sleep(0.1)
+"""
+SLEEP_LINE = 10
+
+BLOCKING_RECV = """\
+import threading
+
+class Reader:
+    def __init__(self, sock):
+        self._lock = threading.Lock()
+        self._sock = sock
+
+    def read(self):
+        with self._lock:
+            return self._sock.recv(4096)
+"""
+RECV_LINE = 10
+
+CLEAN = """\
+import threading
+
+class Element:
+    pass
+
+class CleanCounter(Element):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def chain(self, pad, buf):
+        with self._lock:
+            self.count += 1
+
+    def flush(self):
+        with self._lock:
+            self.count = 0
+"""
+
+
+# ----------------------------------------------------------- lockset pass
+
+class TestLocksetPass:
+    def test_unguarded_shared_write_located(self, tmp_path):
+        got, _ = check(tmp_path, UNGUARDED, rule=UNGUARDED_WRITE)
+        assert len(got) == 1
+        f = got[0]
+        assert f.cls == "BadCounter" and f.attr == "count"
+        assert f.line == 12
+        assert "chain" in f.roles and "api" in f.roles
+        assert f.location.endswith("fixture.py:12")
+
+    def test_consistent_lock_is_clean(self, tmp_path):
+        got, _ = check(tmp_path, CLEAN)
+        assert got == []
+
+    def test_single_writer_rmw_with_readers_is_clean(self, tmp_path):
+        # += from ONE role, plain reads elsewhere: attribute loads are
+        # GIL-atomic reference reads, no lost update is possible
+        got, _ = check(tmp_path, """\
+            class Element:
+                pass
+
+            class SeqCounter(Element):
+                def __init__(self):
+                    self.seq = 0
+
+                def chain(self, pad, buf):
+                    self.seq += 1
+
+                def last_seq(self):
+                    return self.seq
+            """)
+        assert got == []
+
+    def test_single_writer_publication_exempt(self, tmp_path):
+        # the classic publish-then-read flag: one role stores, others read
+        got, _ = check(tmp_path, """\
+            class Element:
+                pass
+
+            class Flag(Element):
+                def __init__(self):
+                    self.healthy = True
+
+                def chain(self, pad, buf):
+                    self.healthy = False    # plain store, single role
+
+                def is_healthy(self):
+                    return self.healthy
+            """)
+        assert got == []
+
+    def test_two_role_plain_stores_flag(self, tmp_path):
+        # stores from TWO roles do not qualify for publication
+        got, _ = check(tmp_path, """\
+            class Element:
+                pass
+
+            class TwoWriters(Element):
+                def __init__(self):
+                    self.mode = "idle"
+
+                def chain(self, pad, buf):
+                    self.mode = "streaming"
+
+                def set_mode(self, m):
+                    self.mode = m
+            """, rule=UNGUARDED_WRITE)
+        assert len(got) == 1
+        assert got[0].attr == "mode"
+
+    def test_safe_typed_attrs_skipped(self, tmp_path):
+        got, _ = check(tmp_path, """\
+            import queue
+            import threading
+
+            class Element:
+                pass
+
+            class Buffered(Element):
+                def __init__(self):
+                    self.q = queue.Queue()
+                    self.evt = threading.Event()
+
+                def chain(self, pad, buf):
+                    self.q.put(buf)
+                    self.evt.set()
+
+                def drain(self):
+                    return self.q.get(timeout=1)
+            """)
+        assert got == []
+
+    def test_helper_under_lock_via_entry_propagation(self, tmp_path):
+        # a private helper only ever called with the lock held is guarded
+        got, _ = check(tmp_path, """\
+            import threading
+
+            class Element:
+                pass
+
+            class Guarded(Element):
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+
+                def chain(self, pad, buf):
+                    with self._lock:
+                        self._bump()
+
+                def _bump(self):
+                    self.count += 1
+
+                def flush(self):
+                    with self._lock:
+                        self.count = 0
+            """)
+        assert got == []
+
+    def test_thread_spawn_target_gets_a_role(self, tmp_path):
+        got, _ = check(tmp_path, """\
+            import threading
+
+            class Puller:
+                def __init__(self):
+                    self.frames = 0
+                    self._thread = threading.Thread(target=self._recv_loop)
+
+                def _recv_loop(self):
+                    while True:
+                        self.frames += 1   # net-reader increments
+
+                def reset(self):
+                    self.frames = 0        # user thread writes too
+            """, rule=UNGUARDED_WRITE)
+        assert len(got) == 1
+        assert got[0].attr == "frames"
+        assert "net-reader" in got[0].roles and "api" in got[0].roles
+
+
+# --------------------------------------------------------- lock-order pass
+
+class TestLockOrderPass:
+    def test_inversion_reports_cycle(self, tmp_path):
+        got, report = check(tmp_path, INVERSION, rule=LOCK_ORDER_CYCLE)
+        assert len(got) == 1
+        assert "Inverted._a" in got[0].message
+        assert "Inverted._b" in got[0].message
+        assert ("Inverted._a", "Inverted._b") in report.lock_edges
+        assert ("Inverted._b", "Inverted._a") in report.lock_edges
+
+    def test_consistent_nesting_is_clean(self, tmp_path):
+        got, report = check(tmp_path, """\
+            import threading
+
+            class Nested:
+                def __init__(self):
+                    self._outer = threading.Lock()
+                    self._inner = threading.Lock()
+
+                def a(self):
+                    with self._outer:
+                        with self._inner:
+                            pass
+
+                def b(self):
+                    with self._outer:
+                        with self._inner:
+                            pass
+            """, rule=LOCK_ORDER_CYCLE)
+        assert got == []
+        assert ("Nested._outer", "Nested._inner") in report.lock_edges
+
+    def test_cycle_through_intra_class_call(self, tmp_path):
+        # the second acquisition hides inside a helper: the edge must
+        # still be seen through the call graph
+        got, _ = check(tmp_path, """\
+            import threading
+
+            class Indirect:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def forward(self):
+                    with self._a:
+                        self._take_b()
+
+                def _take_b(self):
+                    with self._b:
+                        pass
+
+                def backward(self):
+                    with self._b:
+                        with self._a:
+                            pass
+            """, rule=LOCK_ORDER_CYCLE)
+        assert len(got) == 1
+
+    def test_find_cycles_helper(self):
+        assert find_cycles({("a", "b"), ("b", "a")}) == [("a", "b")]
+        assert find_cycles({("a", "b"), ("b", "c")}) == []
+
+
+# ----------------------------------------------------------- blocking pass
+
+class TestBlockingPass:
+    def test_sleep_under_lock_located(self, tmp_path):
+        got, _ = check(tmp_path, SLEEPY, rule=SLEEP_UNDER_LOCK)
+        assert len(got) == 1
+        assert got[0].line == SLEEP_LINE
+        assert "Sleepy._lock" in got[0].message
+
+    def test_blocking_recv_under_lock_located(self, tmp_path):
+        got, _ = check(tmp_path, BLOCKING_RECV, rule=BLOCKING_UNDER_LOCK)
+        assert len(got) == 1
+        assert got[0].line == RECV_LINE
+        assert "recv" in got[0].message
+
+    def test_untimed_queue_get_under_lock(self, tmp_path):
+        got, _ = check(tmp_path, """\
+            import threading
+
+            class Drainer:
+                def __init__(self, q):
+                    self._lock = threading.Lock()
+                    self._q = q
+
+                def drain_one(self):
+                    with self._lock:
+                        return self._q.get()
+            """, rule=BLOCKING_UNDER_LOCK)
+        assert len(got) == 1
+        assert ".get() without timeout" in got[0].message
+
+    def test_timed_get_is_clean(self, tmp_path):
+        got, _ = check(tmp_path, """\
+            import threading
+
+            class Drainer:
+                def __init__(self, q):
+                    self._lock = threading.Lock()
+                    self._q = q
+
+                def drain_one(self):
+                    with self._lock:
+                        return self._q.get(timeout=0.1)
+            """, rule=BLOCKING_UNDER_LOCK)
+        assert got == []
+
+    def test_wait_on_held_condition_exempt(self, tmp_path):
+        # cond.wait() releases the condition it is called on
+        got, _ = check(tmp_path, """\
+            import threading
+
+            class Waiter:
+                def __init__(self):
+                    self._cond = threading.Condition()
+
+                def park(self):
+                    with self._cond:
+                        self._cond.wait()
+            """)
+        assert got == []
+
+    def test_sleep_without_lock_is_clean(self, tmp_path):
+        got, _ = check(tmp_path, """\
+            import time
+
+            def pace():
+                time.sleep(0.1)
+            """)
+        assert got == []
+
+
+# ----------------------------------------------------------------- pragma
+
+class TestPragma:
+    def test_pragma_suppresses_with_reason(self, tmp_path):
+        src = SLEEPY.replace(
+            "time.sleep(0.1)",
+            "time.sleep(0.1)  # racecheck: ok(holdoff is deliberate)")
+        got, report = check(tmp_path, src)
+        assert got == []
+        assert len(report.suppressed) == 1
+        assert report.exit_code == 0
+
+    def test_pragma_on_line_above(self, tmp_path):
+        src = SLEEPY.replace(
+            "            time.sleep(0.1)",
+            "            # racecheck: ok(holdoff)\n"
+            "            time.sleep(0.1)")
+        got, report = check(tmp_path, src)
+        assert got == []
+        assert len(report.suppressed) == 1
+
+    def test_pragma_elsewhere_does_not_blanket(self, tmp_path):
+        # a pragma several lines away must not eat the finding
+        src = "# racecheck: ok(not here)\n" + SLEEPY
+        got, report = check(tmp_path, src)
+        assert report.by_rule(SLEEP_UNDER_LOCK)
+
+
+# -------------------------------------------------- corpus + distinctness
+
+class TestCorpus:
+    def test_four_distinct_finding_classes(self, tmp_path):
+        """The seeded corpus yields all four rule classes, each pinned
+        to its own file:line."""
+        for name, src in [("unguarded.py", UNGUARDED),
+                          ("inversion.py", INVERSION),
+                          ("sleepy.py", SLEEPY),
+                          ("blocking.py", BLOCKING_RECV),
+                          ("clean.py", CLEAN)]:
+            (tmp_path / name).write_text(src)
+        report = analyze_paths([str(tmp_path)])
+        rules = {f.rule for f in report.findings}
+        assert rules == {UNGUARDED_WRITE, LOCK_ORDER_CYCLE,
+                         SLEEP_UNDER_LOCK, BLOCKING_UNDER_LOCK}
+        files = {Path(f.file).name for f in report.findings}
+        assert "clean.py" not in files
+        for f in report.findings:
+            assert f.line > 0 and f.file
+
+    def test_self_scan_is_clean(self):
+        """The gate this PR ships: the package's own sources carry no
+        live findings (deliberate exceptions are pragma'd with reasons)."""
+        report = analyze_paths([str(PACKAGE_DIR)])
+        assert report.findings == [], report.to_text()
+        assert report.exit_code == 0
+
+    def test_static_lock_graph_is_acyclic(self):
+        report = analyze_paths([str(PACKAGE_DIR)])
+        assert find_cycles(report.lock_edges) == []
+
+
+# -------------------------------------------------------------------- CLI
+
+class TestCli:
+    def test_exit_zero_on_clean(self, tmp_path, capsys):
+        f = tmp_path / "clean.py"
+        f.write_text(CLEAN)
+        assert racecheck_main([str(f)]) == 0
+        assert "0 finding(s)" in capsys.readouterr().out
+
+    def test_exit_one_on_findings(self, tmp_path, capsys):
+        f = tmp_path / "sleepy.py"
+        f.write_text(SLEEPY)
+        assert racecheck_main([str(f)]) == 1
+        out = capsys.readouterr().out
+        assert "sleep-under-lock" in out
+        assert f"sleepy.py:{SLEEP_LINE}" in out
+
+    def test_exit_two_on_missing_path(self, tmp_path, capsys):
+        assert racecheck_main([str(tmp_path / "nope")]) == 2
+
+    def test_exit_two_on_bad_flag(self, capsys):
+        assert racecheck_main(["--no-such-flag"]) == 2
+
+    def test_json_round_trip(self, tmp_path, capsys):
+        f = tmp_path / "sleepy.py"
+        f.write_text(SLEEPY)
+        assert racecheck_main([str(f), "--json"]) == 1
+        data = json.loads(capsys.readouterr().out)
+        assert data["exit_code"] == 1
+        assert data["findings"][0]["rule"] == SLEEP_UNDER_LOCK
+        assert data["findings"][0]["line"] == SLEEP_LINE
+
+    def test_output_file_written(self, tmp_path, capsys):
+        f = tmp_path / "clean.py"
+        f.write_text(CLEAN)
+        out = tmp_path / "build" / "racecheck.json"
+        assert racecheck_main([str(f), "-o", str(out), "-q"]) == 0
+        data = json.loads(out.read_text())
+        assert data["exit_code"] == 0
+        assert capsys.readouterr().out == ""  # -q: exit code only
+
+    def test_verbose_lists_suppressed(self, tmp_path, capsys):
+        src = SLEEPY.replace(
+            "time.sleep(0.1)",
+            "time.sleep(0.1)  # racecheck: ok(holdoff)")
+        f = tmp_path / "sleepy.py"
+        f.write_text(src)
+        assert racecheck_main([str(f), "-v"]) == 0
+        assert "suppressed" in capsys.readouterr().out

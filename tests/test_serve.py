@@ -512,3 +512,58 @@ class TestPercentiles:
         assert rep["interlatency_us_p50"] == pytest.approx(3.0)
         assert rep["interlatency_us_p99"] == pytest.approx(100.0)
         assert rep["interlatency_us_max"] == pytest.approx(100.0)
+
+
+# ------------------------------------------------- runtime lock validator
+
+class TestRuntimeLockValidator:
+    def test_serve_path_matches_static_graph(self):
+        """Drive the scheduler's real worker threads under instrumented
+        locks and cross-check the RECORDED acquisition graph against
+        racecheck's static lock-order graph: the run must witness no
+        deadlockable order (acyclic) and no edge the static pass missed."""
+        from pathlib import Path
+
+        import nnstreamer_tpu
+        from nnstreamer_tpu.analysis.concurrency import (
+            LockMonitor, analyze_paths, instrument_counters,
+            instrument_object)
+
+        mon = LockMonitor()
+        sched = ServeScheduler(buckets=(1, 2, 4), max_wait_s=0.002,
+                               invoke_fn=lambda xs: [x * 2 for x in xs])
+        instrument_object(sched, mon)            # ServeScheduler._mlock
+        instrument_object(sched.batcher, mon)    # BucketBatcher._cond
+        instrument_counters(sched.stats, mon)
+        instrument_counters(sched.batcher.stats, mon)
+
+        done = threading.Event()
+        results = []
+        rlock = threading.Lock()
+
+        def on_result(req, row):
+            with rlock:
+                results.append(req.stream_id)
+                if len(results) == 30:
+                    done.set()
+
+        sched.start()
+        try:
+            for i in range(10):
+                for s in range(3):
+                    assert sched.submit(s, [np.full(4, float(i),
+                                                    np.float32)],
+                                        seq=i, on_result=on_result)
+            assert done.wait(timeout=20)
+        finally:
+            sched.stop()
+
+        assert mon.acquisitions, "instrumented locks were never taken"
+        pkg = Path(nnstreamer_tpu.__file__).parent
+        static = analyze_paths([str(pkg)]).lock_edges
+        cycles, missed = mon.check_against_static(static)
+        assert cycles == [], f"runtime witnessed a deadlockable order: {cycles}"
+        assert missed == set(), f"static graph missed edges: {missed}"
+        # the serve path's canonical nestings were actually exercised
+        assert ("ServeScheduler._mlock", "Counters._lock") in mon.edge_set()
+        assert ("BucketBatcher._cond", "Counters._lock") in mon.edge_set()
