@@ -581,6 +581,113 @@ def bench_serve_row(n_clients: int = SERVE_CLIENTS) -> dict:
     return out
 
 
+# -- wire transport row: v1 raw framing vs negotiated compact codec -----------
+
+WIRE_ROW_FRAMES = 400
+
+
+def _wire_stream(cfg, frame, frames: int = WIRE_ROW_FRAMES):
+    """Stream ``frames`` copies of ``frame`` through a real localhost
+    TCP connection under wire config ``cfg`` (None = plain v1 framing);
+    returns (bytes_on_wire_per_frame, sender fps). The receiver fully
+    parses every message (recv_into + decode), so the fps includes both
+    ends' codec cost — the honest A/B for "did compaction pay"."""
+    import socket as _socket
+
+    from nnstreamer_tpu import Buffer
+    from nnstreamer_tpu.edge import wire
+    from nnstreamer_tpu.edge.protocol import MsgKind, recv_msg, send_msg
+    from nnstreamer_tpu.utils.atomic import Counters
+
+    lst = _socket.socket()
+    lst.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+    lst.bind(("localhost", 0))
+    lst.listen(1)
+    done = threading.Event()
+
+    def serve():
+        conn, _ = lst.accept()
+        try:
+            got = 0
+            while got < frames:
+                kind, meta, payloads = recv_msg(conn)
+                if kind != MsgKind.DATA:
+                    break
+                wire.unpack_buffer(meta, payloads)
+                got += 1
+        finally:
+            done.set()
+            conn.close()
+
+    threading.Thread(target=serve, daemon=True).start()
+    out = _socket.create_connection(("localhost", lst.getsockname()[1]))
+    wire.tune_socket(out)
+    stats = Counters()
+    buf = Buffer.from_arrays([frame])
+    t0 = time.perf_counter()
+    for _ in range(frames):
+        meta, payloads = wire.pack_buffer(buf, cfg, stats=stats)
+        send_msg(out, MsgKind.DATA, meta, payloads, stats=stats)
+    done.wait(timeout=120)
+    wall = time.perf_counter() - t0
+    out.close()
+    lst.close()
+    snap = stats.snapshot()
+    return snap.get("wire_bytes_out", 0) / frames, frames / wall
+
+
+def bench_wire_row() -> dict:
+    """Wire row (ISSUE 5 acceptance): the query_fanout payload
+    (224x224x3 u8) over a real local socket, v1 raw framing vs the
+    negotiated compact codec. The compressible frame (smooth gradient —
+    camera-like) must shed >=40% of its wire bytes; the incompressible
+    frame (random u8, the codec's worst case) must not lose throughput
+    — the adaptive skip is what earns that. The compact bytes/frame are
+    then fed back through link_ceiling_fps to show the fps the SAME
+    weather would permit the query_fanout config post-compaction."""
+    import numpy as np
+
+    from nnstreamer_tpu.edge import wire
+
+    out: dict = {}
+    yy, xx = np.mgrid[0:224, 0:224]
+    smooth = np.repeat((((yy + xx) // 2) % 224).astype(np.uint8)[..., None],
+                       3, axis=2).copy()
+    rand = np.random.default_rng(0).integers(
+        0, 255, (224, 224, 3), np.uint8, endpoint=True)
+
+    raw_b, raw_fps = _wire_stream(None, smooth)
+    cfg = wire.negotiate(wire.advertise(), codec="shuffle-zlib")
+    enc_b, enc_fps = _wire_stream(cfg, smooth)
+    out["wire_raw_bytes_per_frame"] = round(raw_b)
+    out["wire_compact_bytes_per_frame"] = round(enc_b)
+    out["wire_bytes_reduction_pct"] = (
+        round(100.0 * (1.0 - enc_b / raw_b), 1) if raw_b else None)
+    out["wire_compressible_fps"] = {"raw": round(raw_fps),
+                                    "compact": round(enc_fps)}
+    ir_b, ir_fps = _wire_stream(None, rand)
+    cfg = wire.negotiate(wire.advertise(), codec="shuffle-zlib")
+    ie_b, ie_fps = _wire_stream(cfg, rand)
+    out["wire_incompressible_bytes_per_frame"] = {"raw": round(ir_b),
+                                                  "compact": round(ie_b)}
+    out["wire_incompressible_fps"] = {"raw": round(ir_fps),
+                                      "compact": round(ie_fps)}
+    out["wire_incompressible_fps_ratio"] = (
+        round(ie_fps / ir_fps, 2) if ir_fps else None)
+    try:
+        w = probe_weather()
+        window = FANOUT_CLIENTS * FANOUT_CLIENT_WINDOW
+        out["wire_link_ceiling_fps"] = {
+            "raw": round(link_ceiling_fps(
+                w, int(raw_b), 1001 * 4, 1, window), 1),
+            "compact": round(link_ceiling_fps(
+                w, int(enc_b), 1001 * 4, 1, window), 1)}
+    except Exception as e:  # noqa: BLE001 -- probe failure degrades to null
+        print(f"# wire ceiling probe failed: {e}", file=sys.stderr)
+        out["wire_link_ceiling_fps"] = None
+    return out
+
+
 # -- device-resident invoke rows (measured-FLOP MFU) --------------------------
 
 def _compiled_flops(jf, *args) -> float:
@@ -943,6 +1050,15 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001
         print(f"# serve row failed: {e}", file=sys.stderr)
         extras["serve_batched_fps"] = None
+
+    # wire transport row: v1 raw framing vs negotiated compact codec
+    # over a real local socket. Comparative A/B within one weather
+    # window (pure host-side, no TPU), so not weather-adjudicated.
+    try:
+        extras.update(bench_wire_row())
+    except Exception as e:  # noqa: BLE001
+        print(f"# wire row failed: {e}", file=sys.stderr)
+        extras["wire_bytes_reduction_pct"] = None
 
     # separate traced pass: tracer bookkeeping must not sit inside the
     # timed region of the fps row above. Long enough (120 frames vs ~40

@@ -408,10 +408,65 @@ class ErrorPolicyRule(Rule):
                     e.name, severity=Severity.ERROR)
 
 
+class WireConfigRule(Rule):
+    """Wire-v2 link properties are negotiated strings: a typo'd codec
+    silently degrades to raw (the peer clamps it), so it must surface at
+    lint time; and a lossy on-wire downcast feeding a trainer corrupts
+    gradients silently — the operator must opt in knowingly."""
+
+    id = "wire-config"
+    severity = Severity.ERROR
+
+    def check(self, ctx: LintContext):
+        from ..edge.wire import CODECS, PRECISIONS
+        for e in ctx.of_kind("tensor_query_client", "edgesink"):
+            codec = str(getattr(e, "wire_codec", "raw"))
+            if codec not in CODECS:
+                yield self.finding(
+                    f"invalid wire-codec {codec!r}; valid: "
+                    f"{', '.join(CODECS)}", e.name)
+            precision = str(getattr(e, "wire_precision", "none"))
+            if precision not in PRECISIONS:
+                yield self.finding(
+                    f"invalid wire-precision {precision!r}; valid: "
+                    f"{', '.join(PRECISIONS)}", e.name)
+            elif precision != "none" and kind_of(e) == "tensor_query_client":
+                # lossy downcast + a trainer consuming the results =
+                # silently degraded gradients; warn loudly
+                seen: Set[str] = set()
+                stack = list(ctx.downstream(e))
+                while stack:
+                    d = stack.pop()
+                    if d.name in seen:
+                        continue
+                    seen.add(d.name)
+                    if kind_of(d) == "tensor_trainer":
+                        yield self.finding(
+                            f"wire-precision={precision} is lossy and the "
+                            f"results feed trainer '{d.name}': gradients "
+                            f"see fp32-rounded activations",
+                            e.name, severity=Severity.WARNING)
+                        break
+                    stack.extend(ctx.downstream(d))
+        for e in ctx.of_kind("edgesink"):
+            frames = int(getattr(e, "coalesce_frames", 1))
+            if frames < 1:
+                yield self.finding(
+                    f"coalesce-frames={frames} is not a batch size; "
+                    f"use 1 to disable coalescing", e.name)
+            elif frames > 1 and float(getattr(e, "coalesce_ms", 0.0)) <= 0:
+                yield self.finding(
+                    "coalesce-frames>1 with coalesce-ms<=0: a partial "
+                    "batch below the size threshold stalls until more "
+                    "frames arrive (no age flush)", e.name,
+                    severity=Severity.WARNING)
+
+
 ALL_RULES: List[Rule] = [
     DanglingPadRule(), CycleRule(), TeeNoQueueRule(), JitSignatureRule(),
     ShardingRule(), SinklessBranchRule(), CombinerDtypeRule(),
     UnboundedAdmissionRule(), LinkResilienceRule(), ErrorPolicyRule(),
+    WireConfigRule(),
 ]
 
 

@@ -8,6 +8,8 @@ in-pod scale-out instead uses jax.sharding over ICI (parallel/).
 from .broker import DiscoveryBroker, discover
 from .mqtt import MqttBroker
 from .protocol import MsgKind, recv_msg, send_msg
+from .wire import WireConfig, accept, advertise, negotiate, tune_socket
 
 __all__ = ["MsgKind", "send_msg", "recv_msg", "DiscoveryBroker", "discover",
-           "MqttBroker"]
+           "MqttBroker", "WireConfig", "advertise", "negotiate", "accept",
+           "tune_socket"]

@@ -76,6 +76,8 @@ class TcpListener:
                 conn, _ = sock.accept()
             except OSError:
                 return
+            from .wire import tune_socket
+            tune_socket(conn)
             if self._spawn:
                 threading.Thread(target=self._on_conn, args=(conn,),
                                  name=f"{self._name}-conn",

@@ -12,6 +12,22 @@ is::
 
 Tensor payloads ride as raw bytes; dtypes/shapes live in the JSON meta so
 flexible streams need no renegotiation.
+
+The framing above is wire v1 and is what every message still looks like
+on the outside. What changed underneath (wire v2, see ``wire.py`` and
+Documentation/edge.md):
+
+* **send** is vectored: ``send_msg`` accepts ndarrays / memoryviews and
+  hands the header + payload views to ``socket.sendmsg`` scatter-gather,
+  so tensor bytes go from the array to the kernel without ``tobytes()``
+  or a ``b"".join`` staging copy.
+* **recv** is zero-copy: ``recv_msg`` preallocates the destination —
+  the exact ndarray described by ``meta["tensors"]`` when the payload is
+  raw, a ``bytearray`` otherwise — and fills it with ``recv_into``.
+  Either way the payload memory is writable and lands once.
+* Negotiated extras (codecs, dtype downcast, DATA_BATCH coalescing) are
+  layered on top by ``wire.py`` and only ever used on links where both
+  peers advertised them; a v1 peer sees byte-identical traffic.
 """
 from __future__ import annotations
 
@@ -19,13 +35,26 @@ import enum
 import json
 import socket
 import struct
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
 MAGIC = 0x4E4E5445
 _HDR = struct.Struct("<IBI")
 _PLEN = struct.Struct("<Q")
+
+# Guards on attacker/corruption-controlled lengths: reject before
+# allocating. 4 GB per tensor payload (the u64 length path must not let
+# a flipped bit demand an exabyte), 64 MB of JSON meta.
+MAX_PAYLOAD = 1 << 32
+MAX_META = 1 << 26
+
+# sendmsg scatter-gather is POSIX; cap the iovec count per call well
+# under any realistic IOV_MAX (Linux: 1024).
+_HAS_SENDMSG = hasattr(socket.socket, "sendmsg")
+_IOV_BATCH = 64
+
+Payload = Union[bytes, bytearray, memoryview, np.ndarray]
 
 
 class MsgKind(enum.IntEnum):
@@ -42,58 +71,197 @@ class MsgKind(enum.IntEnum):
     PUBLISH = 11    # publisher -> message broker: topic payload
     SHED = 12       # server -> client: request dropped (admission or
                     # deadline); meta carries retry_after_ms + seq
+    DATA_BATCH = 13  # N coalesced DATA frames in one message (wire v2
+                     # only: meta template + per-frame binary header)
 
 
-def _read_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        part = sock.recv(n - len(buf))
-        if not part:
+def resolve_dtype(name: str) -> np.dtype:
+    """dtype-by-name, including the ml_dtypes extras (bfloat16) that
+    ``np.dtype`` alone does not know."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # ships with jax; never an extra dependency
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def byte_view(arr: np.ndarray) -> Optional[memoryview]:
+    """A flat writable-agnostic byte view of ``arr``, or None when the
+    dtype defeats the buffer protocol (e.g. bfloat16 on some numpy
+    versions) and the caller must fall back to a copy."""
+    try:
+        return memoryview(arr).cast("B")
+    except (TypeError, ValueError, NotImplementedError):
+        try:
+            return memoryview(arr.view(np.uint8).reshape(-1))
+        except (TypeError, ValueError):
+            return None
+
+
+def as_payload_view(p: Payload) -> Union[bytes, memoryview]:
+    """Normalize one payload to something len()-able and sendable."""
+    if isinstance(p, np.ndarray):
+        if p.size and not p.flags.c_contiguous:
+            p = np.ascontiguousarray(p)
+        v = byte_view(p)
+        return v if v is not None else p.tobytes()
+    if isinstance(p, (bytearray, memoryview)):
+        return memoryview(p).cast("B")
+    return p
+
+
+def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
+    got, n = 0, len(view)
+    while got < n:
+        r = sock.recv_into(view[got:])
+        if not r:
             raise ConnectionError("peer closed")
-        buf.extend(part)
-    return bytes(buf)
+        got += r
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytearray:
+    # one allocation, filled in place (the old version grew a bytearray
+    # through repeated recv()+extend copies)
+    buf = bytearray(n)
+    if n:
+        _recv_exact_into(sock, memoryview(buf))
+    return buf
+
+
+def _sendmsg_all(sock: socket.socket, parts: List[Union[bytes, memoryview]]
+                 ) -> None:
+    """sendall() semantics over a scatter-gather list, resuming cleanly
+    after partial sends; falls back to join+sendall without sendmsg."""
+    if not _HAS_SENDMSG:
+        sock.sendall(b"".join(parts))
+        return
+    pending = [memoryview(p) for p in parts if len(p)]
+    while pending:
+        sent = sock.sendmsg(pending[:_IOV_BATCH])
+        while sent:
+            if sent >= len(pending[0]):
+                sent -= len(pending.pop(0))
+            else:
+                pending[0] = pending[0][sent:]
+                sent = 0
 
 
 def send_msg(sock: socket.socket, kind: MsgKind, meta: Dict,
-             payloads: Sequence[bytes] = ()) -> None:
+             payloads: Sequence[Payload] = (), stats=None) -> int:
+    """Frame + send one message; returns bytes put on the wire.
+
+    Payloads may be bytes, bytearray, memoryview, or ndarray — ndarrays
+    are sent straight from their backing memory (made contiguous only
+    when they are not).
+    """
     mb = json.dumps(meta).encode()
-    parts = [_HDR.pack(MAGIC, int(kind), len(mb)), mb,
-             struct.pack("<I", len(payloads))]
+    parts: List[Union[bytes, memoryview]] = [
+        _HDR.pack(MAGIC, int(kind), len(mb)), mb,
+        struct.pack("<I", len(payloads))]
+    total = _HDR.size + len(mb) + 4
     for p in payloads:
-        parts.append(_PLEN.pack(len(p)))
-        parts.append(p)
-    sock.sendall(b"".join(parts))
+        v = as_payload_view(p)
+        parts.append(_PLEN.pack(len(v)))
+        total += _PLEN.size + len(v)
+        if len(v):
+            parts.append(v)
+    _sendmsg_all(sock, parts)
+    if stats is not None:
+        stats.add(wire_bytes_out=total, wire_msgs_out=1)
+    return total
 
 
-def recv_msg(sock: socket.socket) -> Tuple[MsgKind, Dict, List[bytes]]:
+def _preallocate(meta: Dict, n: int) -> Optional[List[Optional[np.ndarray]]]:
+    """Per-payload destination ndarrays when meta fully describes raw
+    tensors, else None (caller falls back to bytearray — still writable,
+    still filled by recv_into)."""
+    tensors = meta.get("tensors")
+    if not isinstance(tensors, list) or len(tensors) != n:
+        return None
+    out: List[Optional[np.ndarray]] = []
+    for t in tensors:
+        if not isinstance(t, dict) or "codec" in t or "wire_dtype" in t:
+            out.append(None)
+            continue
+        try:
+            out.append(np.empty(tuple(t["shape"]), resolve_dtype(t["dtype"])))
+        except Exception:
+            out.append(None)
+    return out
+
+
+def recv_msg(sock: socket.socket, stats=None
+             ) -> Tuple[MsgKind, Dict, List[Payload]]:
+    """Receive one message. Raw tensor payloads land directly in freshly
+    allocated ndarrays (writable, zero extra copies); anything else
+    (control frames, encoded payloads) comes back as a bytearray."""
     magic, kind, mlen = _HDR.unpack(_read_exact(sock, _HDR.size))
     if magic != MAGIC:
         raise ValueError(f"bad magic {magic:#x}")
-    meta = json.loads(_read_exact(sock, mlen)) if mlen else {}
+    if mlen > MAX_META:
+        raise ValueError(f"meta length {mlen} exceeds {MAX_META} guard")
+    meta = json.loads(bytes(_read_exact(sock, mlen))) if mlen else {}
     (n,) = struct.unpack("<I", _read_exact(sock, 4))
-    payloads = []
-    for _ in range(n):
+    dests = _preallocate(meta, n) if n else None
+    total = _HDR.size + mlen + 4
+    payloads: List[Payload] = []
+    for i in range(n):
         (plen,) = _PLEN.unpack(_read_exact(sock, _PLEN.size))
-        payloads.append(_read_exact(sock, plen))
+        if plen > MAX_PAYLOAD:
+            raise ValueError(
+                f"payload {i} length {plen} exceeds {MAX_PAYLOAD} guard")
+        total += _PLEN.size + plen
+        arr = dests[i] if dests is not None else None
+        view = byte_view(arr) if arr is not None else None
+        if view is not None and len(view) == plen:
+            _recv_exact_into(sock, view)
+            payloads.append(arr)
+        else:
+            payloads.append(_read_exact(sock, plen))
+    if stats is not None:
+        stats.add(wire_bytes_in=total, wire_msgs_in=1)
     return MsgKind(kind), meta, payloads
 
 
-def buffer_to_wire(buf) -> Tuple[Dict, List[bytes]]:
-    """Buffer -> (meta, payloads); dtype/shape per chunk in meta."""
+def buffer_to_wire(buf) -> Tuple[Dict, List[Payload]]:
+    """Buffer -> (meta, payloads); dtype/shape per chunk in meta.
+
+    Payloads are memoryviews over the chunk arrays (no copy) whenever
+    the buffer protocol allows; ``send_msg`` consumes them as-is. This
+    is the plain/v1 path — negotiated codecs live in ``wire.py``.
+    """
     tensors = []
-    payloads = []
+    payloads: List[Payload] = []
     for c in buf.chunks:
-        arr = c.host()
+        arr = np.asarray(c.host())
+        if arr.size and not arr.flags.c_contiguous:
+            arr = np.ascontiguousarray(arr)
         tensors.append({"dtype": str(arr.dtype), "shape": list(arr.shape)})
-        payloads.append(arr.tobytes())
+        payloads.append(arr)
     meta = {"pts": buf.pts, "duration": buf.duration, "tensors": tensors}
     return meta, payloads
 
 
-def wire_to_buffer(meta: Dict, payloads: List[bytes]):
+def wire_to_buffer(meta: Dict, payloads: Sequence[Payload]):
+    """(meta, payloads) -> Buffer with WRITABLE chunk arrays.
+
+    ``recv_msg`` already delivers shaped ndarrays for raw tensors (zero
+    copy); bytearray payloads wrap writably in place; a read-only
+    ``bytes`` payload (v1 peers, tests) is copied once — downstream
+    in-place transforms must never trip on a read-only chunk.
+    """
     from ..tensors.buffer import Buffer, Chunk
     chunks = []
     for t, p in zip(meta.get("tensors", []), payloads):
-        arr = np.frombuffer(p, np.dtype(t["dtype"])).reshape(t["shape"])
+        dtype = resolve_dtype(t["dtype"])
+        shape = tuple(t["shape"])
+        if isinstance(p, np.ndarray) and p.dtype == dtype and \
+                p.shape == shape and p.flags.writeable:
+            arr = p
+        else:
+            raw = p.tobytes() if isinstance(p, np.ndarray) else p
+            arr = np.frombuffer(raw, dtype).reshape(shape)
+            if not arr.flags.writeable:
+                arr = arr.copy()
         chunks.append(Chunk(arr))
     return Buffer(chunks, pts=meta.get("pts"), duration=meta.get("duration"))

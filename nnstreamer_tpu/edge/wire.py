@@ -1,0 +1,400 @@
+"""Wire v2: negotiated codecs, dtype downcast, and frame coalescing.
+
+This module layers optional compaction on top of the v1 framing in
+``protocol.py``; the outer message format never changes, so a v1 peer
+sees byte-identical traffic. The extras are negotiated per link at the
+CAPS/SUBSCRIBE handshake:
+
+* the connecting side sends ``{"wire": advertise(...)}`` inside its
+  handshake meta;
+* the accepting side folds that into its own requested config with
+  :func:`negotiate` and echoes the chosen block in the CAPS_ACK meta;
+* the connecting side adopts the echoed choice with :func:`accept`.
+
+A peer that never mentions ``wire`` (any pre-v2 build) gets ``None`` out
+of both :func:`negotiate` and :func:`accept`, which every call below
+treats as "plain v1": no codec, no downcast, no DATA_BATCH.
+
+Codecs (all lossless):
+
+* ``raw`` — payloads as-is (the zero-copy vectored path).
+* ``zlib`` — per-tensor zlib at a throughput-oriented level.
+* ``shuffle-zlib`` — byte-shuffle (group same-significance bytes across
+  elements, a ``blosc``-style filter) before zlib; float tensors whose
+  exponents dominate compress far better shuffled.
+
+Per-tensor, a codec is only kept when it actually shrinks the payload
+(otherwise the tensor ships raw with no marker), and a link that keeps
+failing to compress stops trying for a while (adaptive skip) so
+incompressible streams pay ~zero codec overhead.
+
+``wire-precision`` (opt-in, lossy): float32 tensors are downcast to
+bfloat16/float16 on the wire and upcast back to float32 on receive; the
+original dtype always rides in meta.
+"""
+from __future__ import annotations
+
+import struct
+import time
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..tensors.buffer import Buffer, BufferFlags, Chunk
+from . import protocol
+from .protocol import Payload, as_payload_view, resolve_dtype
+
+WIRE_VERSION = 2
+
+CODEC_RAW = "raw"
+CODEC_ZLIB = "zlib"
+CODEC_SHUFFLE = "shuffle-zlib"
+CODECS = (CODEC_RAW, CODEC_ZLIB, CODEC_SHUFFLE)
+
+PREC_NONE = "none"
+PREC_BF16 = "bf16"
+PREC_FP16 = "fp16"
+PRECISIONS = (PREC_NONE, PREC_BF16, PREC_FP16)
+_PREC_DTYPE = {PREC_BF16: "bfloat16", PREC_FP16: "float16"}
+
+# numeric codec codes for the compact per-payload ``enc`` list on
+# DATA_BATCH messages (single DATA frames use the per-tensor "codec"
+# meta key instead)
+_CODE_RAW, _CODE_ZLIB, _CODE_SHUFFLE = 0, 1, 2
+_CODE_NAME = {_CODE_ZLIB: CODEC_ZLIB, _CODE_SHUFFLE: CODEC_SHUFFLE}
+
+# don't bother compressing tiny tensors; keep zlib at a
+# throughput-oriented level — the wire win must not cost more pack time
+# than it saves in send time
+MIN_COMPRESS = 512
+COMPRESS_LEVEL = 1
+# a codec result must beat raw by at least this factor to be kept
+KEEP_RATIO = 0.9
+# adaptive skip: after this many consecutive "compression didn't help"
+# tensors, send raw without trying for SKIP_FRAMES tensors, then reprobe
+POOR_LIMIT = 3
+SKIP_FRAMES = 256
+# early abort (the ZFS-compress trick): before compressing a large
+# tensor, deflate just this prefix — if even the sample won't shrink,
+# the tensor ships raw for ~1/10 the cost of a full failed attempt
+PROBE_BYTES = 16384
+
+# per-frame binary header inside a DATA_BATCH payload[0]:
+# seq i64 (-1 = none), pts f64 (NaN = none), duration f64 (NaN = none),
+# flags u32 — replaces per-frame JSON meta
+_FHDR = struct.Struct("<qddI")
+
+
+class WireConfig:
+    """The negotiated per-link wire feature set (+ adaptive codec
+    state). One instance per connection; the skip counters are touched
+    from whatever thread packs for that link, under a leaf lock."""
+
+    __slots__ = ("version", "codec", "precision", "_lock", "_poor", "_skip")
+
+    def __init__(self, codec: str = CODEC_RAW, precision: str = PREC_NONE,
+                 version: int = WIRE_VERSION):
+        import threading
+        self.version = version
+        self.codec = codec if codec in CODECS else CODEC_RAW
+        self.precision = precision if precision in PRECISIONS else PREC_NONE
+        self._lock = threading.Lock()
+        self._poor = 0
+        self._skip = 0
+
+    def to_meta(self) -> Dict:
+        return {"v": self.version, "codec": self.codec,
+                "precision": self.precision, "codecs": list(CODECS),
+                "precisions": list(PRECISIONS)}
+
+    # -- adaptive skip (incompressible streams stop paying for zlib) ---
+    def _try_compress(self) -> bool:
+        with self._lock:
+            if self._skip > 0:
+                self._skip -= 1
+                return False
+            return True
+
+    def _note(self, helped: bool) -> None:
+        with self._lock:
+            if helped:
+                self._poor = 0
+            else:
+                self._poor += 1
+                if self._poor >= POOR_LIMIT:
+                    self._poor = 0
+                    self._skip = SKIP_FRAMES
+
+    def __repr__(self) -> str:
+        return (f"WireConfig(v{self.version}, codec={self.codec}, "
+                f"precision={self.precision})")
+
+
+# -- negotiation -------------------------------------------------------
+
+
+def advertise(codec: str = CODEC_RAW, precision: str = PREC_NONE) -> Dict:
+    """The ``wire`` block a connecting peer puts in its handshake meta:
+    what it supports, plus what it would like for this link."""
+    return {"v": WIRE_VERSION, "codec": codec, "precision": precision,
+            "codecs": list(CODECS), "precisions": list(PRECISIONS)}
+
+
+def negotiate(peer: Optional[Dict], codec: str = CODEC_RAW,
+              precision: str = PREC_NONE) -> Optional[WireConfig]:
+    """Accepting side: fold the peer's advertisement into our own
+    request. Returns None — meaning "speak plain v1" — when the peer
+    did not advertise v2. A non-default local request wins over the
+    peer's wish; either way the result is clamped to what both ends
+    support, falling back to raw/none rather than erroring."""
+    if not isinstance(peer, dict):
+        return None
+    try:
+        if int(peer.get("v", 1)) < WIRE_VERSION:
+            return None
+    except (TypeError, ValueError):
+        return None
+    peer_codecs = set(peer.get("codecs") or (CODEC_RAW,))
+    want = codec if codec != CODEC_RAW else str(peer.get("codec") or CODEC_RAW)
+    chosen = want if want in CODECS and want in peer_codecs else CODEC_RAW
+    peer_precs = set(peer.get("precisions") or (PREC_NONE,))
+    wantp = precision if precision != PREC_NONE \
+        else str(peer.get("precision") or PREC_NONE)
+    chosenp = wantp if wantp in PRECISIONS and wantp in peer_precs \
+        else PREC_NONE
+    return WireConfig(chosen, chosenp)
+
+
+def accept(reply: Optional[Dict]) -> Optional[WireConfig]:
+    """Connecting side: adopt the config the accepting side chose (the
+    ``wire`` block echoed in CAPS_ACK). None — plain v1 — when the
+    peer didn't echo one (any pre-v2 build)."""
+    if not isinstance(reply, dict):
+        return None
+    try:
+        if int(reply.get("v", 1)) < WIRE_VERSION:
+            return None
+    except (TypeError, ValueError):
+        return None
+    return WireConfig(str(reply.get("codec") or CODEC_RAW),
+                      str(reply.get("precision") or PREC_NONE))
+
+
+def tune_socket(sock, bufsize: int = 1 << 20) -> None:
+    """Latency/throughput socket defaults for tensor links: NODELAY
+    (frames are whole messages; never wait on Nagle) and roomy kernel
+    buffers so a burst of coalesced frames doesn't stall the sender."""
+    import socket as _socket
+    try:
+        sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+    except OSError:
+        pass  # AF_UNIX etc.
+    for opt in (_socket.SO_SNDBUF, _socket.SO_RCVBUF):
+        try:
+            sock.setsockopt(_socket.SOL_SOCKET, opt, bufsize)
+        except OSError:
+            pass
+
+
+# -- per-tensor encode/decode ------------------------------------------
+
+
+def _byte_shuffle(view, itemsize: int) -> bytes:
+    """blosc-style shuffle: byte k of every element becomes contiguous."""
+    u8 = np.frombuffer(view, np.uint8)
+    return u8.reshape(-1, itemsize).T.tobytes()
+
+
+def _byte_unshuffle(data: bytes, itemsize: int) -> np.ndarray:
+    u8 = np.frombuffer(data, np.uint8)
+    # transpose().copy() restores element order AND yields writable memory
+    return u8.reshape(itemsize, -1).transpose().copy().reshape(-1)
+
+
+def _encode_tensor(arr: np.ndarray, cfg: Optional[WireConfig]
+                   ) -> Tuple[Payload, Dict, int, int]:
+    """One tensor -> (payload, tensor-meta, raw_nbytes, codec_code)."""
+    arr = np.asarray(arr)
+    if arr.size and not arr.flags.c_contiguous:
+        arr = np.ascontiguousarray(arr)
+    t = {"dtype": str(arr.dtype), "shape": list(arr.shape)}
+    if cfg is not None and cfg.precision != PREC_NONE and \
+            arr.dtype == np.float32:
+        wname = _PREC_DTYPE[cfg.precision]
+        arr = np.ascontiguousarray(arr.astype(resolve_dtype(wname)))
+        t["wire_dtype"] = wname
+    raw = as_payload_view(arr)
+    nraw = len(raw)
+    if cfg is None or cfg.codec == CODEC_RAW or nraw < MIN_COMPRESS or \
+            not cfg._try_compress():
+        return raw, t, nraw, _CODE_RAW
+    itemsize = arr.dtype.itemsize
+    if cfg.codec == CODEC_SHUFFLE and itemsize > 1:
+        data = _byte_shuffle(raw, itemsize)
+        code = _CODE_SHUFFLE
+    else:
+        data = raw
+        code = _CODE_ZLIB
+    if nraw > 4 * PROBE_BYTES and \
+            len(zlib.compress(data[:PROBE_BYTES], COMPRESS_LEVEL)) >= \
+            KEEP_RATIO * PROBE_BYTES:
+        # even the sample won't shrink: incompressible, don't pay for
+        # the full attempt (counts toward the adaptive skip like one)
+        cfg._note(False)
+        return raw, t, nraw, _CODE_RAW
+    comp = zlib.compress(data, COMPRESS_LEVEL)
+    if len(comp) < KEEP_RATIO * nraw:
+        cfg._note(True)
+        return comp, t, nraw, code
+    cfg._note(False)
+    return raw, t, nraw, _CODE_RAW
+
+
+def _decode_tensor(t: Dict, p: Payload, code: Optional[int] = None
+                   ) -> np.ndarray:
+    """One payload -> writable ndarray per its tensor-meta (+ optional
+    numeric codec code from a batch's ``enc`` list)."""
+    codec = _CODE_NAME.get(code) if code is not None else t.get("codec")
+    wname = t.get("wire_dtype")
+    dtype = resolve_dtype(wname or t["dtype"])
+    shape = tuple(t["shape"])
+    if codec == CODEC_SHUFFLE:
+        arr = _byte_unshuffle(zlib.decompress(p), dtype.itemsize) \
+            .view(dtype).reshape(shape)
+    elif codec == CODEC_ZLIB:
+        arr = np.frombuffer(bytearray(zlib.decompress(p)), dtype) \
+            .reshape(shape)
+    elif isinstance(p, np.ndarray) and p.dtype == dtype and \
+            p.shape == shape and p.flags.writeable:
+        arr = p  # recv_msg preallocated it: already in place, writable
+    else:
+        raw = p.tobytes() if isinstance(p, np.ndarray) else p
+        arr = np.frombuffer(raw, dtype).reshape(shape)
+        if not arr.flags.writeable:
+            arr = arr.copy()
+    if wname:
+        arr = arr.astype(resolve_dtype(t["dtype"]))
+    return arr
+
+
+# -- frame pack/unpack -------------------------------------------------
+
+
+def pack_buffer(buf: Buffer, cfg: Optional[WireConfig] = None, stats=None
+                ) -> Tuple[Dict, List[Payload]]:
+    """Buffer -> one DATA/RESULT message body under the link config.
+    With ``cfg=None`` the meta is exactly v1 ``buffer_to_wire`` output
+    (no codec/wire_dtype keys ever appear), so it is always safe for a
+    v1 peer."""
+    t0 = time.perf_counter_ns()
+    tensors: List[Dict] = []
+    payloads: List[Payload] = []
+    nraw = nenc = 0
+    for c in buf.chunks:
+        payload, t, raw_b, code = _encode_tensor(np.asarray(c.host()), cfg)
+        if code != _CODE_RAW:
+            t["codec"] = _CODE_NAME[code]
+        tensors.append(t)
+        payloads.append(payload)
+        nraw += raw_b
+        nenc += len(payload)
+    meta = {"pts": buf.pts, "duration": buf.duration, "tensors": tensors}
+    if stats is not None:
+        stats.add(wire_frames_out=1, wire_raw_bytes_out=nraw,
+                  wire_enc_bytes_out=nenc,
+                  wire_pack_ns=time.perf_counter_ns() - t0)
+    return meta, payloads
+
+
+def unpack_buffer(meta: Dict, payloads: Sequence[Payload], stats=None
+                  ) -> Buffer:
+    """Inverse of :func:`pack_buffer`; handles plain-v1 and every v2
+    codec/precision marker. Chunk arrays are always writable."""
+    if stats is not None:
+        stats.inc("wire_frames_in")
+    tensors = meta.get("tensors", [])
+    if not any("codec" in t or "wire_dtype" in t for t in tensors):
+        return protocol.wire_to_buffer(meta, payloads)
+    chunks = [Chunk(_decode_tensor(t, p)) for t, p in zip(tensors, payloads)]
+    return Buffer(chunks, pts=meta.get("pts"), duration=meta.get("duration"))
+
+
+def batch_compatible(a: Buffer, b: Buffer) -> bool:
+    """Frames can share one DATA_BATCH template iff chunk layouts match."""
+    if len(a.chunks) != len(b.chunks):
+        return False
+    for ca, cb in zip(a.chunks, b.chunks):
+        xa, xb = np.asarray(ca.host()), np.asarray(cb.host())
+        if xa.dtype != xb.dtype or xa.shape != xb.shape:
+            return False
+    return True
+
+
+def pack_batch(bufs: Sequence[Buffer], cfg: Optional[WireConfig] = None,
+               stats=None, seqs: Optional[Sequence[int]] = None
+               ) -> Tuple[Dict, List[Payload]]:
+    """N layout-identical frames -> one DATA_BATCH message body: a meta
+    template (shapes/dtypes once), payload[0] a compact binary per-frame
+    header (seq/pts/duration/flags), then frames×tensors payloads with a
+    numeric ``enc`` codec list. Only ever sent on links that negotiated
+    v2 (a v1 peer cannot parse DATA_BATCH)."""
+    t0 = time.perf_counter_ns()
+    hdr = bytearray(_FHDR.size * len(bufs))
+    template: List[Dict] = []
+    enc: List[int] = []
+    payloads: List[Payload] = [hdr]
+    nraw = nenc = 0
+    for i, buf in enumerate(bufs):
+        seq = seqs[i] if seqs is not None and seqs[i] is not None else -1
+        pts = float("nan") if buf.pts is None else float(buf.pts)
+        dur = float("nan") if buf.duration is None else float(buf.duration)
+        _FHDR.pack_into(hdr, i * _FHDR.size, int(seq), pts, dur,
+                        int(buf.flags))
+        for c in buf.chunks:
+            payload, t, raw_b, code = _encode_tensor(np.asarray(c.host()),
+                                                     cfg)
+            if i == 0:
+                template.append(t)
+            enc.append(code)
+            payloads.append(payload)
+            nraw += raw_b
+            nenc += len(payload)
+    meta = {"wire_batch": 1, "frames": len(bufs), "tensors": template,
+            "enc": enc}
+    if stats is not None:
+        stats.add(wire_frames_out=len(bufs), wire_raw_bytes_out=nraw,
+                  wire_enc_bytes_out=nenc,
+                  wire_pack_ns=time.perf_counter_ns() - t0)
+    return meta, payloads
+
+
+def unpack_batch(meta: Dict, payloads: Sequence[Payload], stats=None
+                 ) -> List[Buffer]:
+    """Inverse of :func:`pack_batch` -> the original frames, in order,
+    with pts/duration/flags restored and seq (when present) in
+    ``extras["seq"]``."""
+    frames = int(meta.get("frames", 0))
+    template = meta.get("tensors", [])
+    enc = meta.get("enc")
+    ntens = len(template)
+    hdr = payloads[0]
+    if stats is not None:
+        stats.add(wire_frames_in=frames)
+    out: List[Buffer] = []
+    idx = 1
+    for i in range(frames):
+        seq, pts, dur, flags = _FHDR.unpack_from(hdr, i * _FHDR.size)
+        chunks = []
+        for j, t in enumerate(template):
+            code = enc[i * ntens + j] if enc else _CODE_RAW
+            chunks.append(Chunk(_decode_tensor(t, payloads[idx], code)))
+            idx += 1
+        buf = Buffer(chunks,
+                     pts=None if pts != pts else pts,
+                     duration=None if dur != dur else dur,
+                     flags=BufferFlags(flags))
+        if seq >= 0:
+            buf.extras["seq"] = seq
+        out.append(buf)
+    return out

@@ -8,13 +8,14 @@ passes ``topic`` at SUBSCRIBE and only receives matching streams.
 """
 from __future__ import annotations
 
+import collections
 import socket
 import threading
 import time
 from typing import Dict, List, Optional
 
-from ..edge.protocol import (MsgKind, buffer_to_wire, recv_msg, send_msg,
-                             wire_to_buffer)
+from ..edge import wire
+from ..edge.protocol import MsgKind, recv_msg, send_msg
 from ..pipeline.element import SinkElement, SrcElement
 from ..pipeline.pad import Pad
 from ..pipeline.registry import register_element
@@ -26,15 +27,34 @@ from ..utils.log import logger
 @register_element("edgesink")
 class EdgeSink(SinkElement):
     PROPS = {"host": "localhost", "port": 3000, "topic": "",
-             "connect-type": "TCP"}
+             "connect-type": "TCP",
+             # wire v2 link request, applied per subscriber that
+             # advertises support (v1 subscribers keep plain framing):
+             # lossless payload codec + opt-in lossy fp32 downcast
+             "wire-codec": "raw", "wire-precision": "none",
+             # frame coalescing: broadcast up to N frames per message
+             # (DATA_BATCH, v2 subscribers only), flushing a partial
+             # batch once its oldest frame has waited coalesce-ms
+             "coalesce-frames": 1, "coalesce-ms": 5.0}
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self._listener: Optional[socket.socket] = None
-        self._subs: List[socket.socket] = []
+        # (socket, negotiated wire config | None) per subscriber
+        self._subs: List[tuple] = []
         self._subs_lock = threading.Lock()
         self._stop_evt = threading.Event()
         self._caps_str = ""
+        # coalesce state: the chain thread appends + size-flushes, the
+        # flush worker age-flushes. _co_lock is held across the whole
+        # take-and-send so the two flushers can neither interleave bytes
+        # on a subscriber socket nor reorder batches (send_msg itself
+        # never blocks under a peer's backpressure longer than the
+        # kernel buffer allows — the same exposure render always had)
+        self._co_lock = threading.Lock()
+        self._co_pending: List[Buffer] = []
+        self._co_t0 = 0.0
+        self._flush_thread: Optional[threading.Thread] = None
 
     @property
     def bound_port(self) -> int:
@@ -50,6 +70,11 @@ class EdgeSink(SinkElement):
         threading.Thread(target=self._accept_loop,
                          name=f"edgesink-accept:{self.name}",
                          daemon=True).start()
+        if int(self.coalesce_frames) > 1:
+            self._flush_thread = threading.Thread(
+                target=self._flush_loop,
+                name=f"edgesink-flush:{self.name}", daemon=True)
+            self._flush_thread.start()
 
     def stop(self) -> None:
         self._stop_evt.set()
@@ -60,7 +85,7 @@ class EdgeSink(SinkElement):
                 pass
             self._listener = None
         with self._subs_lock:
-            for s in self._subs:
+            for s, _cfg in self._subs:
                 try:
                     s.close()
                 except OSError:
@@ -93,35 +118,98 @@ class EdgeSink(SinkElement):
                     send_msg(conn, MsgKind.ERROR, {"reason": "topic mismatch"})
                     conn.close()
                     continue
-                send_msg(conn, MsgKind.CAPS_ACK,
-                         {"caps": self._caps_str, "topic": self.topic})
+                # wire v2: fold the subscriber's advertisement into OUR
+                # requested codec/precision; a v1 subscriber (no "wire"
+                # block) gets plain framing and never sees DATA_BATCH
+                cfg = wire.negotiate(meta.get("wire"),
+                                     codec=str(self.wire_codec),
+                                     precision=str(self.wire_precision))
+                ack = {"caps": self._caps_str, "topic": self.topic}
+                if cfg is not None:
+                    ack["wire"] = cfg.to_meta()
+                send_msg(conn, MsgKind.CAPS_ACK, ack)
+                wire.tune_socket(conn)
             except (ConnectionError, OSError):
                 continue
             with self._subs_lock:
-                self._subs.append(conn)
+                self._subs.append((conn, cfg))
 
     def render(self, buf: Buffer) -> None:
-        meta, payloads = buffer_to_wire(buf)
-        if self.topic:
-            meta["topic"] = self.topic
-        dead = []
+        if int(self.coalesce_frames) <= 1:
+            self._broadcast([buf])
+            return
+        with self._co_lock:
+            if self._co_pending and \
+                    not wire.batch_compatible(self._co_pending[0], buf):
+                # layout change: ship what we have, open a new batch
+                self._broadcast(self._co_pending)
+                self._co_pending = []
+            if not self._co_pending:
+                self._co_t0 = time.monotonic()
+            self._co_pending.append(buf)
+            if len(self._co_pending) >= int(self.coalesce_frames):
+                take, self._co_pending = self._co_pending, []
+                self._broadcast(take)
+
+    def _flush_loop(self) -> None:
+        """Age flush: a partial batch never waits longer than
+        coalesce-ms for stragglers (mirrors the serve batcher's
+        max-wait discipline)."""
+        max_age = max(1e-3, float(self.coalesce_ms) / 1e3)
+        while not self._stop_evt.is_set():
+            self._stop_evt.wait(max_age / 2)
+            with self._co_lock:
+                if self._co_pending and \
+                        time.monotonic() - self._co_t0 >= max_age:
+                    take, self._co_pending = self._co_pending, []
+                    self._broadcast(take)
+
+    def _broadcast(self, frames: List[Buffer]) -> None:
+        """Fan one or more frames out to every subscriber: v2 links get
+        one DATA_BATCH per flush (or codec'd DATA for a single frame),
+        v1 links always get per-frame plain DATA. Messages are packed
+        once per distinct negotiated config, not once per subscriber.
+        When coalescing is on, callers hold _co_lock so size- and
+        age-flushes can neither interleave bytes nor reorder batches."""
         with self._subs_lock:
             subs = list(self._subs)
-        for s in subs:
+        dead = []
+        packed: dict = {}
+        for s, cfg in subs:
+            key = None if cfg is None \
+                else (cfg.codec, cfg.precision, len(frames) > 1)
+            msgs = packed.get(key)
+            if msgs is None:
+                if cfg is not None and len(frames) > 1:
+                    msgs = [(MsgKind.DATA_BATCH,
+                             wire.pack_batch(frames, cfg, stats=self.stats))]
+                else:
+                    msgs = [(MsgKind.DATA,
+                             wire.pack_buffer(f, cfg, stats=self.stats))
+                            for f in frames]
+                if self.topic:
+                    for _, (meta, _pls) in msgs:
+                        meta["topic"] = self.topic
+                packed[key] = msgs
             try:
-                send_msg(s, MsgKind.DATA, meta, payloads)
+                for kind, (meta, payloads) in msgs:
+                    send_msg(s, kind, meta, payloads, stats=self.stats)
             except (ConnectionError, OSError):
                 dead.append(s)
         if dead:
             with self._subs_lock:
-                for s in dead:
-                    if s in self._subs:
-                        self._subs.remove(s)
+                self._subs = [(s, c) for s, c in self._subs
+                              if s not in dead]
 
     def on_eos(self) -> None:
+        # ship any coalesced frames still waiting before the EOS marker
+        with self._co_lock:
+            take, self._co_pending = self._co_pending, []
+            if take:
+                self._broadcast(take)
         with self._subs_lock:
             subs = list(self._subs)
-        for s in subs:
+        for s, _cfg in subs:
             try:
                 send_msg(s, MsgKind.EOS, {})
             except (ConnectionError, OSError):
@@ -141,6 +229,9 @@ class EdgeSrc(SrcElement):
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self._sock: Optional[socket.socket] = None
+        # frames from an unpacked DATA_BATCH beyond the first, drained
+        # before the next recv (only the source loop touches this)
+        self._rxq: "collections.deque" = collections.deque()
         self.stats.update({"reconnects": 0, "link_errors": 0})
 
     def _subscribe(self) -> Caps:
@@ -164,7 +255,11 @@ class EdgeSrc(SrcElement):
             raise ConnectionError(
                 f"{self.name}: cannot reach edgesink at "
                 f"{self.dest_host}:{self.dest_port}: {last_err}")
-        send_msg(self._sock, MsgKind.SUBSCRIBE, {"topic": self.topic})
+        wire.tune_socket(self._sock)
+        # advertise v2 support; the publisher's wire-codec/precision
+        # props decide what this link actually uses (echoed in the ack)
+        send_msg(self._sock, MsgKind.SUBSCRIBE,
+                 {"topic": self.topic, "wire": wire.advertise()})
         kind, meta, _ = recv_msg(self._sock)
         if kind != MsgKind.CAPS_ACK:
             raise ConnectionError(f"{self.name}: subscribe rejected ({kind})")
@@ -193,9 +288,11 @@ class EdgeSrc(SrcElement):
         return True
 
     def create(self) -> Optional[Buffer]:
+        if self._rxq:
+            return self._rxq.popleft()
         while not self._stop_evt.is_set():
             try:
-                kind, meta, payloads = recv_msg(self._sock)
+                kind, meta, payloads = recv_msg(self._sock, stats=self.stats)
             except (ConnectionError, OSError) as exc:
                 if self._stop_evt.is_set():
                     return None
@@ -205,7 +302,13 @@ class EdgeSrc(SrcElement):
                     continue
                 return None
             if kind == MsgKind.DATA:
-                return wire_to_buffer(meta, payloads)
+                return wire.unpack_buffer(meta, payloads, stats=self.stats)
+            if kind == MsgKind.DATA_BATCH:
+                frames = wire.unpack_batch(meta, payloads, stats=self.stats)
+                if not frames:
+                    continue
+                self._rxq.extend(frames[1:])
+                return frames[0]
             if kind == MsgKind.EOS:
                 return None
         return None

@@ -19,8 +19,8 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
-from ..edge.protocol import (MsgKind, buffer_to_wire, recv_msg, send_msg,
-                             wire_to_buffer)
+from ..edge import wire
+from ..edge.protocol import MsgKind, recv_msg, send_msg
 from ..pipeline.element import Element, SinkElement, SrcElement
 from ..pipeline.events import QosEvent
 from ..pipeline.pad import Pad
@@ -37,6 +37,7 @@ class _ServerTable:
     def __init__(self):
         self._lock = threading.Lock()
         self._conns: Dict[Tuple[int, int], socket.socket] = {}
+        self._wire: Dict[Tuple[int, int], wire.WireConfig] = {}
         self._out_caps: Dict[int, str] = {}
 
     def add_conn(self, server_id: int, client_id: int,
@@ -47,10 +48,26 @@ class _ServerTable:
     def remove_conn(self, server_id: int, client_id: int) -> None:
         with self._lock:
             self._conns.pop((server_id, client_id), None)
+            self._wire.pop((server_id, client_id), None)
 
     def get_conn(self, server_id: int, client_id: int):
         with self._lock:
             return self._conns.get((server_id, client_id))
+
+    def set_wire(self, server_id: int, client_id: int,
+                 cfg: Optional[wire.WireConfig]) -> None:
+        """Record the link config negotiated at the client's CAPS
+        exchange; the serversink packs each RESULT under it."""
+        with self._lock:
+            if cfg is None:
+                self._wire.pop((server_id, client_id), None)
+            else:
+                self._wire[(server_id, client_id)] = cfg
+
+    def get_wire(self, server_id: int, client_id: int
+                 ) -> Optional[wire.WireConfig]:
+        with self._lock:
+            return self._wire.get((server_id, client_id))
 
     def set_out_caps(self, server_id: int, caps: str) -> None:
         with self._lock:
@@ -68,6 +85,7 @@ class _ServerTable:
                        if k[0] == server_id]
             for k, _ in victims:
                 del self._conns[k]
+                self._wire.pop(k, None)
         for _, s in victims:
             try:
                 s.close()
@@ -180,6 +198,7 @@ class TensorQueryServerSrc(SrcElement):
                 conn, addr = self._listener.accept()
             except OSError:
                 return
+            wire.tune_socket(conn)
             cid = self._next_client[0]
             self._next_client[0] += 1
             SERVER_TABLE.add_conn(self.id, cid, conn)
@@ -195,20 +214,27 @@ class TensorQueryServerSrc(SrcElement):
         try:
             while not self._stop_evt.is_set():
                 try:
-                    kind, meta, payloads = recv_msg(conn)
+                    kind, meta, payloads = recv_msg(conn, stats=self.stats)
                 except TimeoutError:
                     continue
                 if kind == MsgKind.CAPS:
+                    # wire v2: fold the client's advertisement into this
+                    # link's config and echo the choice in the ack; a
+                    # client without a "wire" block stays plain v1
+                    cfg = wire.negotiate(meta.get("wire"))
+                    SERVER_TABLE.set_wire(self.id, cid, cfg)
                     out_caps = SERVER_TABLE.get_out_caps(self.id) or _FLEX_CAPS
-                    send_msg(conn, MsgKind.CAPS_ACK,
-                             {"caps": out_caps, "client_id": cid})
+                    ack = {"caps": out_caps, "client_id": cid}
+                    if cfg is not None:
+                        ack["wire"] = cfg.to_meta()
+                    send_msg(conn, MsgKind.CAPS_ACK, ack)
                 elif kind == MsgKind.DATA:
-                    buf = wire_to_buffer(meta, payloads)
-                    buf.extras["client_id"] = cid
-                    buf.extras["server_id"] = self.id
-                    with self._qlock:
-                        self._queue.append(buf)
-                        self._qlock.notify_all()
+                    self._enqueue(wire.unpack_buffer(meta, payloads,
+                                                     stats=self.stats), cid)
+                elif kind == MsgKind.DATA_BATCH:
+                    for b in wire.unpack_batch(meta, payloads,
+                                               stats=self.stats):
+                        self._enqueue(b, cid)
                 elif kind == MsgKind.EOS:
                     break
         except (ConnectionError, OSError, ValueError) as exc:
@@ -230,6 +256,13 @@ class TensorQueryServerSrc(SrcElement):
                 conn.close()
             except OSError:
                 pass
+
+    def _enqueue(self, buf: Buffer, cid: int) -> None:
+        buf.extras["client_id"] = cid
+        buf.extras["server_id"] = self.id
+        with self._qlock:
+            self._queue.append(buf)
+            self._qlock.notify_all()
 
     def create(self) -> Optional[Buffer]:
         with self._qlock:
@@ -313,10 +346,12 @@ class TensorQueryServerSink(SinkElement):
         if conn is None:
             logger.warning("%s: no connection for client %s", self.name, cid)
             return
-        meta, payloads = buffer_to_wire(buf)
+        # pack under whatever this client's link negotiated (None = v1)
+        meta, payloads = wire.pack_buffer(
+            buf, SERVER_TABLE.get_wire(sid, cid), stats=self.stats)
         meta["client_id"] = cid
         try:
-            send_msg(conn, MsgKind.RESULT, meta, payloads)
+            send_msg(conn, MsgKind.RESULT, meta, payloads, stats=self.stats)
         except (ConnectionError, OSError):
             SERVER_TABLE.remove_conn(sid, cid)
 
@@ -340,7 +375,12 @@ class TensorQueryClient(Element):
     SRC_TEMPLATES = {"src": "other/tensors"}
     PROPS = {"host": "localhost", "port": 3001, "dest-host": "",
              "dest-port": 0, "timeout": 10.0, "max-request": 8,
-             "connect-type": "TCP", "topic": ""}
+             "connect-type": "TCP", "topic": "",
+             # wire v2 link request: lossless payload codec
+             # (raw|zlib|shuffle-zlib) and opt-in lossy fp32 downcast
+             # (none|bf16|fp16); both silently fall back to raw/none
+             # against a server that doesn't support them
+             "wire-codec": "raw", "wire-precision": "none"}
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
@@ -354,13 +394,21 @@ class TensorQueryClient(Element):
         # unanswered requests, oldest first: replayed on reconnect so a
         # server death loses no frames (at-least-once; results map back
         # FIFO because the server pipeline preserves per-client order).
-        # Each entry is [meta, payloads, sent_generation]; comparing the
-        # generation against _conn_gen under _send_lock makes send and
-        # replay idempotent, so a frame is sent at most once per
-        # connection no matter how sender and reconnector interleave.
+        # Each entry is [buffer, seq, sent_generation]; the BUFFER (not
+        # serialized bytes) is held so a replay re-encodes under the NEW
+        # connection's negotiated wire config — failing over from a
+        # codec-speaking server to a v1 one must not replay stale-codec
+        # payloads. Comparing the generation against _conn_gen under
+        # _send_lock makes send and replay idempotent, so a frame is
+        # sent at most once per connection no matter how sender and
+        # reconnector interleave.
         self._pending: "collections.deque" = collections.deque()
         self._plock = threading.Lock()
         self._conn_gen = 0
+        # negotiated per-connection wire config (None = v1 peer);
+        # published under _conn_lock together with the socket it belongs
+        # to, so a sender always packs for the link it sends on
+        self._wire_cfg: Optional[wire.WireConfig] = None
         self._last_caps: Optional[Caps] = None
         self._server_caps = _FLEX_CAPS
         # per-request wire correlation: serving servers (tensor_serve_*)
@@ -435,9 +483,12 @@ class TensorQueryClient(Element):
             sock = socket.create_connection((host, port), timeout=timeout)
         except OSError:
             return False
+        wire.tune_socket(sock)
         try:
             send_msg(sock, MsgKind.CAPS,
-                     {"caps": str(self._last_caps or "")})
+                     {"caps": str(self._last_caps or ""),
+                      "wire": wire.advertise(str(self.wire_codec),
+                                             str(self.wire_precision))})
             kind, meta, _ = recv_msg(sock)
             if kind != MsgKind.CAPS_ACK:
                 raise ConnectionError(f"{self.name}: bad handshake {kind}")
@@ -447,8 +498,10 @@ class TensorQueryClient(Element):
             # caller never reads half-initialized state
             sock.settimeout(None)
             self._server_caps = meta.get("caps", _FLEX_CAPS)
+            cfg = wire.accept(meta.get("wire"))
             with self._conn_lock:
                 self._sock = sock
+                self._wire_cfg = cfg
                 self._conn_gen += 1
                 gen = self._conn_gen
                 self._inflight = threading.Semaphore(
@@ -457,10 +510,11 @@ class TensorQueryClient(Element):
                 target=self._recv_loop, args=(sock, self._inflight),
                 name=f"qclient-recv:{self.name}", daemon=True)
             self._recv_thread.start()
-            # replay unanswered frames in order on the new connection;
-            # the send lock is held across the whole replay so a new
-            # frame from the streaming thread cannot interleave and break
-            # the FIFO request->result pairing; the generation mark skips
+            # replay unanswered frames in order on the new connection —
+            # re-encoded under THIS connection's negotiated config; the
+            # send lock is held across the whole replay so a new frame
+            # from the streaming thread cannot interleave and break the
+            # FIFO request->result pairing; the generation mark skips
             # entries the streaming thread already sent on THIS connection
             with self._send_lock:
                 with self._plock:
@@ -471,7 +525,11 @@ class TensorQueryClient(Element):
                     if not self._inflight.acquire(timeout=self.timeout):
                         raise ConnectionError(
                             f"{self.name}: replay stalled")
-                    send_msg(sock, MsgKind.DATA, entry[0], entry[1])
+                    meta, payloads = wire.pack_buffer(entry[0], cfg,
+                                                      stats=self.stats)
+                    meta["seq"] = entry[1]
+                    send_msg(sock, MsgKind.DATA, meta, payloads,
+                             stats=self.stats)
                     entry[2] = gen
             return True
         except (ConnectionError, OSError):
@@ -489,6 +547,7 @@ class TensorQueryClient(Element):
             if sock is not None and sock is not self._sock:
                 return
             old, self._sock = self._sock, None
+            self._wire_cfg = None
             # fresh permit pool: replies owed on the dead connection will
             # never come, and blocked senders must not burn the timeout
             self._inflight = threading.Semaphore(max(1, self.max_request))
@@ -509,11 +568,12 @@ class TensorQueryClient(Element):
         self.set_src_caps(Caps(self._server_caps))
 
     def do_chain(self, pad: Pad, buf: Buffer) -> None:
-        meta, payloads = buffer_to_wire(buf)
-        meta["seq"] = self._seq = self._seq + 1
+        seq = self._seq = self._seq + 1
         with self._conn_lock:
             self._last_caps = pad.caps or self._last_caps
-        entry = [meta, payloads, -1]  # -1 = not yet sent on any connection
+        # the entry holds the BUFFER: it is packed at send time, under
+        # the config of the connection it actually goes out on
+        entry = [buf, seq, -1]  # -1 = not yet sent on any connection
         with self._plock:
             self._pending.append(entry)
         for attempt in (1, 2):
@@ -526,6 +586,7 @@ class TensorQueryClient(Element):
                 with self._conn_lock:
                     sock, gen = self._sock, self._conn_gen
                     inflight = self._inflight
+                    cfg = self._wire_cfg
                 if sock is None:
                     raise ConnectionError(f"{self.name}: not connected")
                 if entry[2] == gen:
@@ -536,7 +597,11 @@ class TensorQueryClient(Element):
                     if entry[2] == gen:   # replay won the race meanwhile
                         inflight.release()
                     else:
-                        send_msg(sock, MsgKind.DATA, meta, payloads)
+                        meta, payloads = wire.pack_buffer(buf, cfg,
+                                                          stats=self.stats)
+                        meta["seq"] = seq
+                        send_msg(sock, MsgKind.DATA, meta, payloads,
+                                 stats=self.stats)
                         entry[2] = gen
                 return
             except TimeoutError:
@@ -574,7 +639,7 @@ class TensorQueryClient(Element):
         with self._plock:
             if seq is not None:
                 for i, entry in enumerate(self._pending):
-                    if entry[0].get("seq") == seq:
+                    if entry[1] == seq:
                         del self._pending[i]
                         return
             if self._pending:
@@ -584,7 +649,7 @@ class TensorQueryClient(Element):
                    inflight: threading.Semaphore) -> None:
         try:
             while not self._stop_evt.is_set():
-                kind, meta, payloads = recv_msg(sock)
+                kind, meta, payloads = recv_msg(sock, stats=self.stats)
                 if kind in (MsgKind.RESULT, MsgKind.SHED):
                     with self._conn_lock:
                         stale = sock is not self._sock
@@ -610,7 +675,8 @@ class TensorQueryClient(Element):
                     # push before releasing: on_eos drains by acquiring all
                     # permits, so releasing first would let EOS overtake
                     # (and drop) this final result downstream
-                    self.srcpad.push(wire_to_buffer(meta, payloads))
+                    self.srcpad.push(wire.unpack_buffer(meta, payloads,
+                                                        stats=self.stats))
                     inflight.release()
                 elif kind == MsgKind.EOS:
                     break

@@ -21,7 +21,8 @@ import socket
 import threading
 from typing import Dict, Optional, Tuple
 
-from ..edge.protocol import MsgKind, recv_msg, send_msg, wire_to_buffer
+from ..edge import wire
+from ..edge.protocol import MsgKind, recv_msg, send_msg
 from ..pipeline.element import SinkElement, SrcElement
 from ..pipeline.pad import Pad
 from ..pipeline.registry import register_element
@@ -65,10 +66,13 @@ class TensorServeSrc(SrcElement):
         self._listener: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
         self._next_client = [0]
-        # cid -> (conn, send lock): replies come from the sink's
-        # streaming thread, sheds from the batcher and recv threads —
-        # the per-connection lock keeps wire frames atomic
-        self._conns: Dict[int, Tuple[socket.socket, threading.Lock]] = {}
+        # cid -> (conn, send lock, negotiated wire config): replies come
+        # from the sink's streaming thread, sheds from the batcher and
+        # recv threads — the per-connection lock keeps wire frames
+        # atomic; the config (None = plain v1 peer) is rebound under
+        # _clock once the client's CAPS advertisement arrives
+        self._conns: Dict[int, Tuple[socket.socket, threading.Lock,
+                                     Optional[wire.WireConfig]]] = {}
         self._clock = threading.Lock()
         self.scheduler: Optional[ServeScheduler] = None
         self.stats["link_errors"] = 0
@@ -116,7 +120,7 @@ class TensorServeSrc(SrcElement):
         with self._clock:
             victims = list(self._conns.values())
             self._conns.clear()
-        for conn, _ in victims:
+        for conn, _, _ in victims:
             try:
                 conn.close()
             except OSError:
@@ -129,10 +133,11 @@ class TensorServeSrc(SrcElement):
                 conn, _addr = self._listener.accept()
             except OSError:
                 return
+            wire.tune_socket(conn)
             cid = self._next_client[0]
             self._next_client[0] += 1
             with self._clock:
-                self._conns[cid] = (conn, threading.Lock())
+                self._conns[cid] = (conn, threading.Lock(), None)
             threading.Thread(target=self._client_loop, args=(conn, cid),
                              name=f"serve-client{cid}:{self.name}",
                              daemon=True).start()
@@ -144,14 +149,29 @@ class TensorServeSrc(SrcElement):
         try:
             while not self._stop_evt.is_set():
                 try:
-                    kind, meta, payloads = recv_msg(conn)
+                    kind, meta, payloads = recv_msg(conn, stats=self.stats)
                 except TimeoutError:
                     continue  # idle keep-alive; re-check stop
                 if kind == MsgKind.CAPS:
-                    send_msg(conn, MsgKind.CAPS_ACK,
-                             {"caps": _FLEX_CAPS, "client_id": cid})
+                    # wire v2 negotiation: fold the client's advertised
+                    # codec/precision wish into the link config and echo
+                    # the choice; a client without a "wire" block is a
+                    # v1 peer and gets plain v1 replies
+                    cfg = wire.negotiate(meta.get("wire"))
+                    with self._clock:
+                        entry = self._conns.get(cid)
+                        if entry is not None:
+                            self._conns[cid] = (entry[0], entry[1], cfg)
+                    ack = {"caps": _FLEX_CAPS, "client_id": cid}
+                    if cfg is not None:
+                        ack["wire"] = cfg.to_meta()
+                    send_msg(conn, MsgKind.CAPS_ACK, ack)
                 elif kind == MsgKind.DATA:
-                    self._admit(conn, cid, meta, payloads)
+                    self._admit(cid, meta, payloads)
+                elif kind == MsgKind.DATA_BATCH:
+                    for b in wire.unpack_batch(meta, payloads,
+                                               stats=self.stats):
+                        self._admit_buf(cid, b, b.extras.get("seq"))
                 elif kind == MsgKind.EOS:
                     break
         except (ConnectionError, OSError, ValueError) as exc:
@@ -169,21 +189,29 @@ class TensorServeSrc(SrcElement):
             except OSError:
                 pass
 
-    def _admit(self, conn: socket.socket, cid: int, meta, payloads) -> None:
-        buf = wire_to_buffer(meta, payloads)
+    def _admit(self, cid: int, meta, payloads) -> None:
+        buf = wire.unpack_buffer(meta, payloads, stats=self.stats)
+        self._admit_buf(cid, buf, meta.get("seq"))
+
+    def _admit_buf(self, cid: int, buf: Buffer, seq) -> None:
         self.scheduler.submit(
             cid, [c.host() for c in buf.chunks],
-            seq=meta.get("seq"), pts=buf.pts,
+            seq=seq, pts=buf.pts,
             on_result=self._on_result, on_shed=self._on_shed)
 
     # -- reply side (called by the scheduler's demux) ----------------------
     def _on_result(self, req: Request, row) -> None:
-        meta = {"pts": req.pts, "duration": None, "client_id": req.stream_id,
-                "seq": req.seq,
-                "tensors": [{"dtype": str(a.dtype), "shape": list(a.shape)}
-                            for a in row]}
-        self._send(req.stream_id, MsgKind.RESULT, meta,
-                   [a.tobytes() for a in row])
+        # encode under the client's negotiated link config (None = v1:
+        # byte-identical to the old raw framing, minus the copies)
+        with self._clock:
+            entry = self._conns.get(req.stream_id)
+        cfg = entry[2] if entry is not None else None
+        meta, payloads = wire.pack_buffer(
+            Buffer.from_arrays(list(row), pts=req.pts), cfg,
+            stats=self.stats)
+        meta["client_id"] = req.stream_id
+        meta["seq"] = req.seq
+        self._send(req.stream_id, MsgKind.RESULT, meta, payloads)
 
     def _on_shed(self, req: Request) -> None:
         # backpressure on the wire: the client translates this into an
@@ -199,10 +227,10 @@ class TensorServeSrc(SrcElement):
         if entry is None:
             logger.warning("%s: no connection for client %s", self.name, cid)
             return
-        conn, lock = entry
+        conn, lock, _cfg = entry
         try:
             with lock:
-                send_msg(conn, kind, meta, payloads)
+                send_msg(conn, kind, meta, payloads, stats=self.stats)
         except (ConnectionError, OSError):
             self._drop_client(cid)
 

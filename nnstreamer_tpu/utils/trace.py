@@ -33,6 +33,31 @@ from typing import Any, Dict, Sequence
 _RESERVOIR_K = 512
 
 
+def _wire_summary(st: Dict[str, Any]) -> Dict[str, Any]:
+    """Condense an element's wire_* counters (edge/wire.py) into the
+    per-link block report() exposes; {} when the element never touched
+    a socket, so non-networked elements stay uncluttered."""
+    out: Dict[str, Any] = {}
+    for key in ("wire_bytes_out", "wire_bytes_in",
+                "wire_msgs_out", "wire_msgs_in"):
+        if st.get(key):
+            out[key[5:]] = st[key]
+    raw, enc = st.get("wire_raw_bytes_out", 0), st.get("wire_enc_bytes_out", 0)
+    if raw and enc:
+        out["compress_ratio"] = round(raw / enc, 3)
+    frames_out = st.get("wire_frames_out", 0)
+    if frames_out:
+        out["frames_out"] = frames_out
+        out["pack_us_avg"] = round(
+            st.get("wire_pack_ns", 0) / frames_out / 1e3, 2)
+        msgs = st.get("wire_msgs_out", 0)
+        if msgs:
+            out["frames_per_msg"] = round(frames_out / msgs, 2)
+    if st.get("wire_frames_in"):
+        out["frames_in"] = st["wire_frames_in"]
+    return out
+
+
 class Reservoir:
     """Algorithm-R bounded reservoir: O(1) cost per observation, fixed
     memory, uniformly representative of the whole stream — the classic
@@ -166,6 +191,9 @@ class Tracer:
                 for key in ("dropped", "retries", "restarts", "shed"):
                     if st.get(key):
                         entry[key] = st[key]
+                w = _wire_summary(st)
+                if w:
+                    entry["wire"] = w
                 q = getattr(el, "_q", None)
                 if q is not None and hasattr(q, "qsize"):
                     entry["queue_level"] = q.qsize()

@@ -242,6 +242,64 @@ class TestRules:
                 "on_error=restart ! appsink name=out")
         assert findings_for(desc, "error-policy") == []
 
+    def test_wire_codec_typo_is_error(self):
+        bad = (  # pipelint: skip — typo'd codec would silently run raw
+            f"tensortestsrc caps={CAPS_U8} ! "
+            "tensor_query_client name=qc wire-codec=zlibb ! "
+            "appsink name=out")
+        got = findings_for(bad, "wire-config")
+        assert [(f.element, f.severity) for f in got] == \
+            [("qc", Severity.ERROR)]
+        assert "zlibb" in got[0].message and "shuffle-zlib" in got[0].message
+
+    def test_wire_precision_typo_is_error(self):
+        bad = (  # pipelint: skip — typo'd precision would silently run none
+            f"tensortestsrc caps={CAPS_U8} ! "
+            "edgesink name=e wire-precision=fp8")
+        got = findings_for(bad, "wire-config")
+        assert [(f.element, f.severity) for f in got] == \
+            [("e", Severity.ERROR)]
+        assert "fp8" in got[0].message
+
+    def test_lossy_precision_feeding_trainer_warns(self):
+        bad = (  # pipelint: skip — bf16 wire downcast feeds a trainer
+            f"tensortestsrc caps={CAPS_U8} ! "
+            "tensor_query_client name=qc wire-precision=bf16 ! "
+            "tensor_trainer name=tr ! appsink name=out")
+        got = findings_for(bad, "wire-config")
+        assert [(f.element, f.severity) for f in got] == \
+            [("qc", Severity.WARNING)]
+        assert "tr" in got[0].message and "lossy" in got[0].message
+
+    def test_lossy_precision_without_trainer_is_clean(self):
+        desc = (f"tensortestsrc caps={CAPS_U8} ! "
+                "tensor_query_client name=qc wire-precision=bf16 ! "
+                "appsink name=out")
+        assert findings_for(desc, "wire-config") == []
+
+    def test_coalesce_frames_zero_is_error(self):
+        bad = (  # pipelint: skip — 0 is not a batch size
+            f"tensortestsrc caps={CAPS_U8} ! "
+            "edgesink name=e coalesce-frames=0")
+        got = findings_for(bad, "wire-config")
+        assert [(f.element, f.severity) for f in got] == \
+            [("e", Severity.ERROR)]
+
+    def test_coalesce_without_age_flush_warns(self):
+        bad = (  # pipelint: skip — partial batch would stall forever
+            f"tensortestsrc caps={CAPS_U8} ! "
+            "edgesink name=e coalesce-frames=8 coalesce-ms=0")
+        got = findings_for(bad, "wire-config")
+        assert [(f.element, f.severity) for f in got] == \
+            [("e", Severity.WARNING)]
+        assert "age flush" in got[0].message
+
+    def test_wire_config_valid_specs_are_clean(self):
+        desc = (f"tensortestsrc caps={CAPS_U8} ! "
+                "edgesink name=e wire-codec=shuffle-zlib "
+                "coalesce-frames=8 coalesce-ms=5")
+        assert findings_for(desc, "wire-config") == []
+
 
 CLEAN_CORPUS = [
     # straight filter chain on fixed caps

@@ -1,0 +1,562 @@
+"""Wire v2: negotiated codecs, downcast, coalescing, and the zero-copy
+transport (edge/wire.py + edge/protocol.py).
+
+Covers the unit layer (codec round-trips over every TensorType dtype,
+negotiation matrix, DATA_BATCH pack/unpack), the socket layer (vectored
+send / recv_into over a real socketpair, payload-length guard), strict
+v1 interop (a raw-socket peer that never says "wire" must see plain v1
+traffic), and the element layer (query + edge pipelines under
+wire-codec=zlib, coalescing flush-by-size and flush-by-age).
+"""
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import Buffer, parse_launch
+from nnstreamer_tpu.edge import protocol, wire
+from nnstreamer_tpu.edge.protocol import (MsgKind, buffer_to_wire, recv_msg,
+                                          send_msg, wire_to_buffer)
+from nnstreamer_tpu.tensors.types import TensorType
+from nnstreamer_tpu.utils.atomic import Counters
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _arr(ttype: TensorType, shape=(3, 5)) -> np.ndarray:
+    """A deterministic non-trivial array of the given tensor type."""
+    rng = np.random.default_rng(int(ttype))
+    dt = ttype.np_dtype
+    if np.issubdtype(np.dtype(str(dt)) if str(dt) != "bfloat16"
+                     else np.float32, np.floating) or "float" in str(dt):
+        return rng.standard_normal(shape).astype(np.float32).astype(dt)
+    info = np.iinfo(dt)
+    return rng.integers(info.min, info.max, shape, dtype=dt,
+                        endpoint=False)
+
+
+CAPS = ('other/tensors,format=static,num_tensors=1,'
+        'types=(string)float32,dimensions=(string)4')
+
+
+# -- codec round-trips --------------------------------------------------------
+
+
+class TestCodecRoundTrip:
+    @pytest.mark.parametrize("ttype", list(TensorType))
+    @pytest.mark.parametrize("codec", wire.CODECS)
+    def test_all_dtypes(self, ttype, codec):
+        arr = _arr(ttype, shape=(16, 33))
+        cfg = wire.WireConfig(codec)
+        meta, payloads = wire.pack_buffer(
+            Buffer.from_arrays([arr], pts=7), cfg)
+        out = wire.unpack_buffer(meta, payloads)
+        got = out.chunks[0].host()
+        assert got.dtype == arr.dtype and got.shape == arr.shape
+        np.testing.assert_array_equal(np.asarray(got).view(np.uint8),
+                                      np.asarray(arr).view(np.uint8))
+        assert got.flags.writeable
+        assert out.pts == 7
+
+    @pytest.mark.parametrize("codec", wire.CODECS)
+    def test_zero_size_tensor(self, codec):
+        arr = np.empty((0, 4), np.float32)
+        cfg = wire.WireConfig(codec)
+        meta, payloads = wire.pack_buffer(Buffer.from_arrays([arr]), cfg)
+        got = wire.unpack_buffer(meta, payloads).chunks[0].host()
+        assert got.shape == (0, 4) and got.dtype == np.float32
+
+    @pytest.mark.parametrize("codec", wire.CODECS)
+    def test_non_contiguous_input(self, codec):
+        base = np.arange(240, dtype=np.int32).reshape(12, 20)
+        arr = base[::2, ::2]  # stride-2 view, not C-contiguous
+        assert not arr.flags.c_contiguous
+        cfg = wire.WireConfig(codec)
+        meta, payloads = wire.pack_buffer(Buffer.from_arrays([arr]), cfg)
+        got = wire.unpack_buffer(meta, payloads).chunks[0].host()
+        np.testing.assert_array_equal(got, arr)
+
+    def test_compressible_actually_shrinks(self):
+        arr = np.zeros((64, 64), np.float32)  # trivially compressible
+        cfg = wire.WireConfig(wire.CODEC_ZLIB)
+        stats = Counters()
+        meta, payloads = wire.pack_buffer(Buffer.from_arrays([arr]), cfg,
+                                          stats=stats)
+        assert meta["tensors"][0]["codec"] == wire.CODEC_ZLIB
+        assert len(payloads[0]) < arr.nbytes * 0.1
+        snap = stats.snapshot()
+        assert snap["wire_enc_bytes_out"] < snap["wire_raw_bytes_out"]
+
+    def test_incompressible_ships_raw_after_adaptive_skip(self):
+        arr = np.frombuffer(np.random.default_rng(0).bytes(1 << 16),
+                            np.uint8).copy()
+        cfg = wire.WireConfig(wire.CODEC_ZLIB)
+        for _ in range(wire.POOR_LIMIT + 1):
+            meta, payloads = wire.pack_buffer(Buffer.from_arrays([arr]), cfg)
+            # never kept: random bytes cannot beat KEEP_RATIO
+            assert "codec" not in meta["tensors"][0]
+        assert cfg._skip > 0  # the link stopped paying for attempts
+
+    def test_v1_meta_is_exact_without_cfg(self):
+        buf = Buffer.from_arrays([np.arange(6, dtype=np.float32)], pts=3)
+        assert wire.pack_buffer(buf, None)[0] == buffer_to_wire(buf)[0]
+
+
+# -- precision downcast -------------------------------------------------------
+
+
+class TestPrecisionDowncast:
+    @pytest.mark.parametrize("prec,rtol", [("bf16", 1.0 / 128),
+                                           ("fp16", 1e-3)])
+    def test_fidelity_bounds(self, prec, rtol):
+        arr = np.random.default_rng(1).standard_normal(
+            (32, 8)).astype(np.float32)
+        cfg = wire.WireConfig(precision=prec)
+        meta, payloads = wire.pack_buffer(Buffer.from_arrays([arr]), cfg)
+        assert meta["tensors"][0]["wire_dtype"] == wire._PREC_DTYPE[prec]
+        assert len(payloads[0]) == arr.nbytes // 2  # halved on the wire
+        got = wire.unpack_buffer(meta, payloads).chunks[0].host()
+        assert got.dtype == np.float32  # original dtype restored
+        np.testing.assert_allclose(got, arr, rtol=rtol, atol=1e-6)
+
+    def test_non_float32_left_alone(self):
+        arr = np.arange(12, dtype=np.int32)
+        cfg = wire.WireConfig(precision="bf16")
+        meta, payloads = wire.pack_buffer(Buffer.from_arrays([arr]), cfg)
+        assert "wire_dtype" not in meta["tensors"][0]
+        got = wire.unpack_buffer(meta, payloads).chunks[0].host()
+        np.testing.assert_array_equal(got, arr)
+
+
+# -- negotiation matrix -------------------------------------------------------
+
+
+class TestNegotiation:
+    def test_v1_peer_means_plain(self):
+        assert wire.negotiate(None) is None
+        assert wire.negotiate({}) is None  # no version claim
+        assert wire.negotiate({"v": 1}) is None
+        assert wire.accept(None) is None
+        assert wire.accept({"v": 1}) is None
+
+    def test_peer_wish_adopted_when_local_default(self):
+        cfg = wire.negotiate(wire.advertise(codec="zlib", precision="fp16"))
+        assert cfg.codec == "zlib" and cfg.precision == "fp16"
+
+    def test_local_request_wins_over_peer_wish(self):
+        cfg = wire.negotiate(wire.advertise(codec="zlib"),
+                             codec="shuffle-zlib")
+        assert cfg.codec == "shuffle-zlib"
+
+    def test_unsupported_codec_clamped_to_raw(self):
+        peer = {"v": 2, "codec": "lz99", "codecs": ["raw", "lz99"]}
+        cfg = wire.negotiate(peer)
+        assert cfg is not None and cfg.codec == "raw"
+        # and the reverse: we want what the peer can't speak
+        peer = {"v": 2, "codec": "raw", "codecs": ["raw"]}
+        assert wire.negotiate(peer, codec="zlib").codec == "raw"
+
+    def test_accept_adopts_echoed_choice(self):
+        server_cfg = wire.negotiate(wire.advertise(), codec="zlib",
+                                    precision="bf16")
+        client_cfg = wire.accept(server_cfg.to_meta())
+        assert client_cfg.codec == "zlib"
+        assert client_cfg.precision == "bf16"
+
+
+# -- DATA_BATCH pack/unpack ---------------------------------------------------
+
+
+class TestBatch:
+    def test_round_trip_restores_per_frame_meta(self):
+        bufs = [Buffer.from_arrays(
+            [np.full((4, 4), float(i), np.float32)], pts=i * 100)
+            for i in range(5)]
+        bufs[2].duration = 40
+        cfg = wire.WireConfig(wire.CODEC_ZLIB)
+        meta, payloads = wire.pack_batch(bufs, cfg, seqs=[10, 11, 12, 13, 14])
+        assert meta["frames"] == 5 and len(meta["tensors"]) == 1
+        out = wire.unpack_batch(meta, payloads)
+        assert len(out) == 5
+        for i, b in enumerate(out):
+            assert b.pts == i * 100
+            assert b.extras["seq"] == 10 + i
+            np.testing.assert_array_equal(
+                b.chunks[0].host(), np.full((4, 4), float(i), np.float32))
+        assert out[2].duration == 40
+
+    def test_batch_compatible_gates_on_layout(self):
+        a = Buffer.from_arrays([np.zeros(4, np.float32)])
+        b = Buffer.from_arrays([np.zeros(4, np.float32)])
+        c = Buffer.from_arrays([np.zeros(5, np.float32)])
+        d = Buffer.from_arrays([np.zeros(4, np.int32)])
+        assert wire.batch_compatible(a, b)
+        assert not wire.batch_compatible(a, c)
+        assert not wire.batch_compatible(a, d)
+
+
+# -- socket layer: vectored send / recv_into / guards -------------------------
+
+
+class TestSocketTransport:
+    def test_round_trip_preallocates_writable_arrays(self):
+        a, b = socket.socketpair()
+        try:
+            arr = np.arange(1024, dtype=np.float32).reshape(32, 32)
+            meta, payloads = buffer_to_wire(Buffer.from_arrays([arr], pts=5))
+            tx = Counters()
+            rx = Counters()
+            sent = send_msg(a, MsgKind.DATA, meta, payloads, stats=tx)
+            kind, rmeta, rpay = recv_msg(b, stats=rx)
+            assert kind == MsgKind.DATA
+            # raw tensors land as shaped writable ndarrays, no copy step
+            assert isinstance(rpay[0], np.ndarray)
+            assert rpay[0].flags.writeable
+            out = wire_to_buffer(rmeta, rpay)
+            np.testing.assert_array_equal(out.chunks[0].host(), arr)
+            out.chunks[0].host()[0, 0] = -1.0  # writable end to end
+            assert tx.snapshot()["wire_bytes_out"] == sent
+            assert rx.snapshot()["wire_bytes_in"] == sent
+            assert tx.snapshot()["wire_msgs_out"] == 1
+        finally:
+            a.close()
+            b.close()
+
+    def test_zero_size_payload_on_the_wire(self):
+        a, b = socket.socketpair()
+        try:
+            meta, payloads = buffer_to_wire(
+                Buffer.from_arrays([np.empty(0, np.uint8)]))
+            send_msg(a, MsgKind.DATA, meta, payloads)
+            _, rmeta, rpay = recv_msg(b)
+            assert wire_to_buffer(rmeta, rpay).chunks[0].host().shape == (0,)
+        finally:
+            a.close()
+            b.close()
+
+    def test_payload_length_guard_rejects_before_allocating(self):
+        a, b = socket.socketpair()
+        try:
+            # hand-frame a message whose payload claims > MAX_PAYLOAD
+            mb = b"{}"
+            a.sendall(protocol._HDR.pack(protocol.MAGIC, int(MsgKind.DATA),
+                                         len(mb)) + mb +
+                      struct.pack("<I", 1) +
+                      protocol._PLEN.pack(protocol.MAX_PAYLOAD + 1))
+            with pytest.raises(ValueError, match="exceeds"):
+                recv_msg(b)
+        finally:
+            a.close()
+            b.close()
+
+    def test_meta_length_guard(self):
+        a, b = socket.socketpair()
+        try:
+            a.sendall(protocol._HDR.pack(protocol.MAGIC, int(MsgKind.DATA),
+                                         protocol.MAX_META + 1))
+            with pytest.raises(ValueError, match="meta length"):
+                recv_msg(b)
+        finally:
+            a.close()
+            b.close()
+
+    def test_sendmsg_fallback_path_matches(self, monkeypatch):
+        monkeypatch.setattr(protocol, "_HAS_SENDMSG", False)
+        a, b = socket.socketpair()
+        try:
+            arr = np.arange(64, dtype=np.int16)
+            meta, payloads = buffer_to_wire(Buffer.from_arrays([arr]))
+            send_msg(a, MsgKind.DATA, meta, payloads)
+            _, rmeta, rpay = recv_msg(b)
+            np.testing.assert_array_equal(
+                wire_to_buffer(rmeta, rpay).chunks[0].host(), arr)
+        finally:
+            a.close()
+            b.close()
+
+
+# -- strict v1 interop --------------------------------------------------------
+
+
+class TestV1Interop:
+    def test_v1_subscriber_gets_plain_frames(self):
+        """A raw-socket subscriber that never says "wire" must receive
+        per-frame plain-v1 DATA even when the publisher asks for a codec
+        AND coalescing — downgrade is per link, not per element."""
+        port = _free_port()
+        pub = parse_launch(
+            f'appsrc name=in caps="{CAPS}" '
+            f'! edgesink port={port} topic=t wire-codec=zlib '
+            'coalesce-frames=4 coalesce-ms=5')
+        pub.start()
+        time.sleep(0.2)
+        sub = socket.create_connection(("localhost", port), timeout=10)
+        try:
+            send_msg(sub, MsgKind.SUBSCRIBE, {"topic": "t"})  # no "wire"
+            kind, meta, _ = recv_msg(sub)
+            assert kind == MsgKind.CAPS_ACK
+            assert "wire" not in meta  # no v2 echo for a v1 peer
+            for i in range(3):
+                pub["in"].push_buffer(Buffer.from_arrays(
+                    [np.full(4, float(i), np.float32)]))
+            got = []
+            sub.settimeout(10)
+            while len(got) < 3:
+                kind, meta, payloads = recv_msg(sub)
+                assert kind == MsgKind.DATA  # never DATA_BATCH
+                t = meta["tensors"][0]
+                assert "codec" not in t and "wire_dtype" not in t
+                got.append(wire_to_buffer(meta, payloads))
+            for i, b in enumerate(got):
+                np.testing.assert_array_equal(
+                    b.chunks[0].host(), np.full(4, float(i), np.float32))
+        finally:
+            sub.close()
+            pub["in"].end_stream()
+            pub.stop()
+
+    def test_v1_query_client_round_trips_unchanged(self):
+        """A raw-socket v1 client against the upgraded server: CAPS
+        without a wire block -> plain v1 both directions."""
+        port = _free_port()
+        server = parse_launch(
+            f'tensor_query_serversrc port={port} id=70 '
+            '! tensor_transform mode=arithmetic option=mul:2.0 '
+            '! tensor_query_serversink id=70')
+        server.start()
+        time.sleep(0.2)
+        conn = socket.create_connection(("localhost", port), timeout=10)
+        try:
+            send_msg(conn, MsgKind.CAPS, {"caps": CAPS})
+            kind, ack, _ = recv_msg(conn)
+            assert kind == MsgKind.CAPS_ACK and "wire" not in ack
+            arr = np.full(4, 3.0, np.float32)
+            meta, payloads = buffer_to_wire(Buffer.from_arrays([arr]))
+            meta["seq"] = 0
+            send_msg(conn, MsgKind.DATA, meta, payloads)
+            conn.settimeout(10)
+            kind, rmeta, rpay = recv_msg(conn)
+            assert kind == MsgKind.RESULT
+            assert "codec" not in rmeta["tensors"][0]
+            np.testing.assert_array_equal(
+                wire_to_buffer(rmeta, rpay).chunks[0].host(),
+                np.full(4, 6.0, np.float32))
+        finally:
+            conn.close()
+            server.stop()
+
+    def test_client_downgrades_when_ack_has_no_wire_block(self):
+        """tensor_query_client asking for a codec against a server that
+        never echoes "wire" (a pre-v2 build): the link silently runs
+        plain v1 — the request is a wish, not a requirement."""
+        port = _free_port()
+        done = threading.Event()
+        got = {}
+
+        def v1_server():
+            lst = socket.socket()
+            lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            lst.bind(("localhost", port))
+            lst.listen(1)
+            lst.settimeout(15)
+            conn, _ = lst.accept()
+            try:
+                kind, meta, _ = recv_msg(conn)
+                assert kind == MsgKind.CAPS
+                send_msg(conn, MsgKind.CAPS_ACK, {})  # v1: no wire echo
+                kind, meta, payloads = recv_msg(conn)
+                got["meta"] = meta
+                # echo the frame back as the RESULT
+                meta = dict(meta)
+                meta["client_id"] = meta.get("client_id")
+                send_msg(conn, MsgKind.RESULT, meta, payloads)
+                done.wait(10)
+            finally:
+                conn.close()
+                lst.close()
+
+        t = threading.Thread(target=v1_server, daemon=True)
+        t.start()
+        client = parse_launch(
+            f'appsrc name=in caps="{CAPS}" '
+            f'! tensor_query_client port={port} timeout=15 wire-codec=zlib '
+            '! appsink name=out')
+        client.start()
+        # zeros are maximally compressible: if the client ignored the
+        # downgrade this payload WOULD have shipped with a codec marker
+        client["in"].push_buffer(Buffer.from_arrays(
+            [np.zeros(4, np.float32)]))
+        deadline = time.monotonic() + 15
+        while not client["out"].buffers and time.monotonic() < deadline:
+            time.sleep(0.05)
+        done.set()
+        client["in"].end_stream()
+        client.stop()
+        t.join(timeout=10)
+        assert client["out"].buffers
+        assert "codec" not in got["meta"]["tensors"][0]
+
+
+# -- element layer: pipelines under wire v2 -----------------------------------
+
+
+class TestPipelinesUnderV2:
+    def test_query_round_trip_with_codec(self):
+        port = _free_port()
+        server = parse_launch(
+            f'tensor_query_serversrc port={port} id=71 '
+            '! tensor_transform mode=arithmetic option=add:1.0 '
+            '! tensor_query_serversink id=71')
+        server.start()
+        time.sleep(0.2)
+        client = parse_launch(
+            f'appsrc name=in caps="{CAPS}" '
+            f'! tensor_query_client name=qc port={port} timeout=15 '
+            'wire-codec=zlib ! appsink name=out')
+        client.start()
+        # compressible payloads so the codec actually engages
+        for i in range(4):
+            client["in"].push_buffer(Buffer.from_arrays(
+                [np.full(4, float(i), np.float32)]))
+        deadline = time.monotonic() + 20
+        while len(client["out"].buffers) < 4 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        client["in"].end_stream()
+        stats = client["qc"].stats.snapshot()
+        client.stop()
+        server.stop()
+        out = client["out"].buffers
+        assert len(out) == 4
+        for i, b in enumerate(out):
+            np.testing.assert_array_equal(
+                b.chunks[0].host(), np.full(4, 1.0 + float(i), np.float32))
+            assert b.chunks[0].host().flags.writeable
+        # the link carried traffic and counted it
+        assert stats["wire_msgs_out"] >= 4
+        assert stats["wire_bytes_out"] > 0
+        assert stats["wire_frames_in"] == 4
+
+    def test_edge_pub_sub_with_codec_and_downcast(self):
+        port = _free_port()
+        pub = parse_launch(
+            f'appsrc name=in caps="{CAPS}" '
+            f'! edgesink name=p port={port} topic=t wire-codec=zlib '
+            'wire-precision=fp16')
+        pub.start()
+        time.sleep(0.2)
+        sub = parse_launch(
+            f'edgesrc dest-port={port} topic=t timeout=15 '
+            '! appsink name=out')
+        sub.start()
+        time.sleep(0.3)
+        vals = [0.125, 1.5, -2.25]  # fp16-exact so equality holds
+        for v in vals:
+            pub["in"].push_buffer(Buffer.from_arrays(
+                [np.full(4, v, np.float32)]))
+        deadline = time.monotonic() + 15
+        while len(sub["out"].buffers) < 3 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        pub["in"].end_stream()
+        sub.wait_eos(timeout=15)
+        sub.stop()
+        pub.stop()
+        got = sub["out"].buffers
+        assert len(got) == 3
+        for v, b in zip(vals, got):
+            arr = b.chunks[0].host()
+            assert arr.dtype == np.float32  # upcast back on receive
+            np.testing.assert_array_equal(arr, np.full(4, v, np.float32))
+
+
+# -- coalescing ---------------------------------------------------------------
+
+
+class TestCoalescing:
+    def test_flush_by_size_preserves_order(self):
+        port = _free_port()
+        pub = parse_launch(
+            f'appsrc name=in caps="{CAPS}" '
+            f'! edgesink name=p port={port} coalesce-frames=4 '
+            'coalesce-ms=500')
+        pub.start()
+        time.sleep(0.2)
+        sub = parse_launch(
+            f'edgesrc dest-port={port} timeout=15 ! appsink name=out')
+        sub.start()
+        time.sleep(0.3)
+        for i in range(8):  # exactly two full batches
+            pub["in"].push_buffer(Buffer.from_arrays(
+                [np.full(4, float(i), np.float32)], pts=i * 10))
+        deadline = time.monotonic() + 15
+        while len(sub["out"].buffers) < 8 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        pub_stats = pub["p"].stats.snapshot()
+        pub["in"].end_stream()
+        sub.wait_eos(timeout=15)
+        sub.stop()
+        pub.stop()
+        got = sub["out"].buffers
+        assert [float(b.chunks[0].host()[0]) for b in got] == \
+            [float(i) for i in range(8)]
+        assert [b.pts for b in got] == [i * 10 for i in range(8)]
+        # 8 frames crossed in 2 messages: coalescing actually engaged
+        assert pub_stats["wire_frames_out"] == 8
+        assert pub_stats["wire_msgs_out"] <= 3  # 2 batches (+caps slack)
+
+    def test_flush_by_age(self):
+        """A partial batch (2 of 8 frames) must not wait for stragglers:
+        the age flusher ships it within ~coalesce-ms."""
+        port = _free_port()
+        pub = parse_launch(
+            f'appsrc name=in caps="{CAPS}" '
+            f'! edgesink port={port} coalesce-frames=8 coalesce-ms=40')
+        pub.start()
+        time.sleep(0.2)
+        sub = parse_launch(
+            f'edgesrc dest-port={port} timeout=15 ! appsink name=out')
+        sub.start()
+        time.sleep(0.3)
+        t0 = time.monotonic()
+        for i in range(2):
+            pub["in"].push_buffer(Buffer.from_arrays(
+                [np.full(4, float(i), np.float32)]))
+        deadline = t0 + 10
+        while len(sub["out"].buffers) < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        elapsed = time.monotonic() - t0
+        pub["in"].end_stream()
+        sub.wait_eos(timeout=15)
+        sub.stop()
+        pub.stop()
+        assert len(sub["out"].buffers) == 2  # arrived without 6 more frames
+        assert elapsed < 5.0  # age flush, not the 10 s give-up deadline
+
+    def test_eos_flushes_pending(self):
+        """Frames still coalescing at EOS are delivered, then EOS."""
+        port = _free_port()
+        pub = parse_launch(
+            f'appsrc name=in caps="{CAPS}" '
+            f'! edgesink port={port} coalesce-frames=16 coalesce-ms=60000')
+        pub.start()
+        time.sleep(0.2)
+        sub = parse_launch(
+            f'edgesrc dest-port={port} timeout=15 ! appsink name=out')
+        sub.start()
+        time.sleep(0.3)
+        for i in range(3):
+            pub["in"].push_buffer(Buffer.from_arrays(
+                [np.full(4, float(i), np.float32)]))
+        pub["in"].end_stream()  # EOS while 3 frames sit in the batch
+        sub.wait_eos(timeout=15)
+        sub.stop()
+        pub.stop()
+        assert len(sub["out"].buffers) == 3
