@@ -9,7 +9,7 @@ SHELL := /bin/bash
 LIB := $(BUILD)/libnnstpu.so
 EXAMPLES := $(BUILD)/custom_passthrough.so $(BUILD)/custom_scaler.so
 
-.PHONY: native clean test check tier1 lint racecheck chaos package
+.PHONY: native clean test check tier1 lint racecheck chaos fuse-parity package
 
 native: $(LIB) $(EXAMPLES)
 
@@ -20,7 +20,15 @@ native: $(LIB) $(EXAMPLES)
 check: native lint racecheck
 	python -m pytest tests/ -q -m 'not slow'
 	python -c "import nnstreamer_tpu as nt; print('import ok:', len(nt.pipeline.registry.element_names()), 'elements')"
+	$(MAKE) fuse-parity
 	$(MAKE) chaos
+
+# `make fuse-parity` = the fusion compiler's byte-parity oracle: every
+# fusible pipeline in the corpus (plus a built-in representative suite)
+# must produce byte-identical sink output fused and unfused
+# (tools/fuse_parity.py exits nonzero on any divergence).
+fuse-parity:
+	env JAX_PLATFORMS=cpu python tools/fuse_parity.py
 
 # `make chaos` = the full fault-injection harness including the slow
 # seeded serve-pipeline schedules (excluded from tier-1 by the slow

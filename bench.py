@@ -57,15 +57,17 @@ DEVRES_TOP1_WINDOW = 96
 
 def run_pipeline(desc: str, warmup: int, frames: int,
                  frames_per_buffer: int = 1, timeout: float = 600.0,
-                 trace: dict | None = None):
+                 trace: dict | None = None, fuse: bool = True):
     """Run a pipeline; time frames [warmup, warmup+frames) and collect
     steady-state inter-arrival times. Returns (fps, p50_frame_us).
     Pass ``trace={}`` to fill it with the tracer's per-element report
     (proctime/interlatency/framerate — where the wall time actually
-    goes, SURVEY §5 tracing)."""
+    goes, SURVEY §5 tracing). ``fuse=False`` pins the per-element chain
+    path (same knob as the ``fuse=false`` launch property)."""
     from nnstreamer_tpu.pipeline.parser import parse_launch
 
     pipe = parse_launch(desc)
+    pipe.fuse = fuse
     tracer = pipe.enable_tracing() if trace is not None else None
     mark = {"t0": None, "t1": None, "n": 0, "stamps": []}
     done = threading.Event()
@@ -375,6 +377,31 @@ def bench_deeplab():
         f"max-size-buffers={INFLIGHT_WINDOW} "
         "! tensor_decoder mode=image_segment option1=tflite-deeplab "
         "! appsink name=out", warmup=10, frames=200)
+    return fps, p50
+
+
+def bench_pipeline_fused(fuse: bool = True, n: int | None = None,
+                         warm: int | None = None):
+    """Fused device-resident row: the placement compiler
+    (nnstreamer_tpu/fusion/) collapses filter+decoder into ONE XLA
+    program, so the 21-channel logits never exist off-device — the
+    frame's only D2H is the decoded RGBA overlay. No queue between the
+    two (a queue is a thread boundary and breaks the run); the source
+    cycles HBM-staged frames so no input bytes cross the link either.
+    ``fuse=False`` runs the identical description on the per-element
+    chain path — the overhead the compiler is supposed to delete (the
+    twin runs SHORT via ``n``: at ~5.5 MB of logits D2H per frame a
+    full-length unfused run is minutes of pure link time)."""
+    n, warm = n or 200, warm or 24
+    fps, p50 = run_pipeline(
+        f"tensortestsrc caps={caps('3:257:257')} pattern=random "
+        f"device=true unique=true num-buffers={n + warm} "
+        "! queue max-size-buffers=8 "
+        "! tensor_filter framework=jax model=zoo://deeplab_v3 "
+        "prefetch-host=true "
+        "! tensor_decoder mode=image_segment option1=tflite-deeplab "
+        f"! queue max-size-buffers={INFLIGHT_WINDOW} "
+        "! appsink name=out", warmup=warm, frames=n, fuse=fuse)
     return fps, p50
 
 
@@ -700,7 +727,7 @@ def _compiled_flops(jf, *args) -> float:
 
 
 def _chained_invoke_fps(zoo_name: str, batch: int, scan_len: int,
-                        n_outer: int):
+                        n_outer: int, hw: int = 224):
     """Device-resident invoke throughput a lazy transport cannot fake.
 
     The dev chip is remote-attached; its transport defers/caches
@@ -736,7 +763,7 @@ def _chained_invoke_fps(zoo_name: str, batch: int, scan_len: int,
 
     reduce_j = jax.jit(lambda a: a.astype(jnp.int32).sum())
     frame = np.random.default_rng(0).integers(
-        0, 255, (batch, 224, 224, 3), np.uint8, endpoint=True)
+        0, 255, (batch, hw, hw, 3), np.uint8, endpoint=True)
     x = jax.device_put(frame)
     # warm with DIFFERENT args than the timed chain's first call: the
     # caching transport would otherwise serve that whole first scan
@@ -1013,6 +1040,37 @@ def main() -> int:
             100.0 * row1["fps"] / inv32, 1)
     except Exception as e:  # noqa: BLE001
         print(f"# devres pipeline failed: {e}", file=sys.stderr)
+
+    # -- FUSED pipeline-vs-invoke: the fusion compiler collapses
+    # deeplab+image_segment into one XLA program (one dispatch and one
+    # D2H per frame — the 264 KB RGBA overlay, never the 5.5 MB
+    # logits), measured against the same chained-invoke oracle at the
+    # row's own batch/shape. The unfused twin of the IDENTICAL
+    # description runs short (its per-frame logits D2H is exactly the
+    # cost being deleted) so fused_vs_unfused_pct shows the compiler's
+    # own win, not a config difference.
+    try:
+        invd, _, _, _ = _chained_invoke_fps("deeplab_v3", 1,
+                                            scan_len=25, n_outer=2, hw=257)
+        rowf = adjudicated("fused_devres_deeplab",
+                           bench_pipeline_fused,
+                           bytes_in_per_buffer=0,
+                           bytes_out_per_buffer=257 * 257 * 4,
+                           frames_per_buffer=1)
+        rowf["pipeline_vs_invoke_pct"] = round(100.0 * rowf["fps"] / invd, 1)
+        configs["fused_devres_deeplab"] = rowf
+        extras["invoke_deeplab_fps"] = round(invd, 1)
+        extras["fused_devres_deeplab_fps"] = rowf["fps"]
+        extras["fused_pipeline_vs_invoke_pct"] = rowf["pipeline_vs_invoke_pct"]
+        try:
+            unfused_fps, _ = bench_pipeline_fused(fuse=False, n=40, warm=8)
+            extras["unfused_devres_deeplab_fps"] = round(unfused_fps, 2)
+            extras["fused_vs_unfused_pct"] = round(
+                100.0 * rowf["fps"] / unfused_fps, 1)
+        except Exception as e:  # noqa: BLE001
+            print(f"# unfused twin failed: {e}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001
+        print(f"# fused devres pipeline failed: {e}", file=sys.stderr)
 
     headline_attempt()  # mid-session attempt
 

@@ -9,11 +9,12 @@ the default pre-PLAYING gate, and ``python -m nnstreamer_tpu lint``.
 """
 from .findings import (Finding, PipelineValidationError,  # noqa: F401
                        Report, Severity)
-from .infer import InferenceResult, infer_caps  # noqa: F401
+from .infer import (InferenceResult, config_of,  # noqa: F401
+                    element_transfer, infer_caps)
 from .rules import ALL_RULES, LintContext, Rule, analyze  # noqa: F401
 
 __all__ = [
     "Severity", "Finding", "Report", "PipelineValidationError",
-    "InferenceResult", "infer_caps", "Rule", "LintContext", "ALL_RULES",
-    "analyze",
+    "InferenceResult", "infer_caps", "element_transfer", "config_of",
+    "Rule", "LintContext", "ALL_RULES", "analyze",
 ]

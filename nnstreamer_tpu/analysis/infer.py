@@ -51,6 +51,52 @@ class InferenceResult:
         return {pname: self.pad_caps.get(pad)
                 for pname, pad in elem.src_pads.items()}
 
+    def in_config(self, elem: Element) -> Optional[TensorsConfig]:
+        """Tensor config on a single-sink element's input, else None.
+        Convenience shared by lint rules and the fusion planner."""
+        caps = self.in_caps(elem)
+        if len(caps) != 1:
+            return None
+        return config_of(next(iter(caps.values())))
+
+    def out_config(self, elem: Element) -> Optional[TensorsConfig]:
+        caps = self.out_caps(elem)
+        if len(caps) != 1:
+            return None
+        return config_of(next(iter(caps.values())))
+
+
+def element_transfer(
+        elem: Element, in_caps: Dict[str, Optional[Caps]],
+        findings: Optional[List[Finding]] = None,
+) -> Dict[str, Optional[Caps]]:
+    """Invoke *elem*'s declared :meth:`Element.static_transfer` under the
+    shared error discipline. This is the single call site contract —
+    pipelint propagation, the fusion rules, and the fusion planner all
+    go through here, so each element declares its transfer exactly once
+    and every consumer maps its failures the same way: TransferError /
+    ValueError become findings (when a sink is passed), anything else is
+    a lint bug and degrades to unknown."""
+    try:
+        return elem.static_transfer(in_caps) or {}
+    except TransferError as exc:
+        if findings is not None:
+            findings.append(Finding(
+                RULE_CAPS, Severity.ERROR, str(exc), elem.name, exc.pad))
+        return {}
+    except ValueError as exc:
+        # the same error runtime negotiation would raise mid-stream
+        if findings is not None:
+            pad = (next(iter(elem.sink_pads))
+                   if len(elem.sink_pads) == 1 else None)
+            findings.append(Finding(
+                RULE_CAPS, Severity.ERROR, str(exc), elem.name, pad))
+        return {}
+    except Exception:  # noqa: BLE001 -- never block launch on a lint bug
+        logger.debug("pipelint: %s.static_transfer failed; treating "
+                     "outputs as unknown", elem.name, exc_info=True)
+        return {}
+
 
 def _topo_order(elements: List[Element]):
     """Kahn's algorithm over pad links. Returns (order, cyclic_names):
@@ -83,24 +129,7 @@ def infer_caps(pipeline) -> InferenceResult:
     order, cyclic = _topo_order(elements)
     res = InferenceResult(cyclic=cyclic, order=order)
     for elem in order:
-        in_caps = res.in_caps(elem)
-        try:
-            out = elem.static_transfer(in_caps) or {}
-        except TransferError as exc:
-            res.findings.append(Finding(
-                RULE_CAPS, Severity.ERROR, str(exc), elem.name, exc.pad))
-            out = {}
-        except ValueError as exc:
-            # the same error runtime negotiation would raise mid-stream
-            pad = (next(iter(elem.sink_pads))
-                   if len(elem.sink_pads) == 1 else None)
-            res.findings.append(Finding(
-                RULE_CAPS, Severity.ERROR, str(exc), elem.name, pad))
-            out = {}
-        except Exception:  # noqa: BLE001 -- never block launch on a lint bug
-            logger.debug("pipelint: %s.static_transfer failed; treating "
-                         "outputs as unknown", elem.name, exc_info=True)
-            out = {}
+        out = element_transfer(elem, res.in_caps(elem), res.findings)
         for pname, pad in elem.src_pads.items():
             res.pad_caps[pad] = out.get(pname)
     return res

@@ -462,11 +462,83 @@ class WireConfigRule(Rule):
                     severity=Severity.WARNING)
 
 
+class FusionBreakRule(Rule):
+    """A single non-fusible element sandwiched between two device-fusible
+    neighbors splits what would otherwise be one FusedSegment into two
+    (or none) — every split re-crosses the host/device boundary, which on
+    a remote-attached TPU costs a full RTT per frame."""
+
+    id = "fusion-break"
+    severity = Severity.WARNING
+
+    def check(self, ctx: LintContext):
+        from ..fusion.planner import static_veto
+        for e in ctx.elements:
+            if isinstance(e, (SrcElement, SinkElement)):
+                continue  # runs necessarily end at the graph edge
+            reason = static_veto(e, ctx.inference)
+            if reason is None:
+                continue
+            ups = [p.peer.element for p in e.sink_pads.values()
+                   if p.peer is not None]
+            downs = [p.peer.element for p in e.src_pads.values()
+                     if p.peer is not None]
+            if len(ups) != 1 or len(downs) != 1:
+                continue
+            up, down = ups[0], downs[0]
+            if static_veto(up, ctx.inference) is not None \
+                    or static_veto(down, ctx.inference) is not None:
+                continue
+            yield self.finding(
+                f"breaks a device-fusible run between '{up.name}' and "
+                f"'{down.name}' ({reason}); move it outside the run, or "
+                f"accept per-element dispatch with fuse=false", e.name)
+
+
+class FusionTransferRule(Rule):
+    """An element that declares a device_fn promises the fusion planner
+    that its *static* caps transfer matches what the chain path
+    negotiates at runtime (``transform_caps``). If they disagree, a
+    fused segment advertises caps the unfused pipeline never produces —
+    a guaranteed parity break, so this is an error."""
+
+    id = "fusion-transfer"
+    severity = Severity.ERROR
+
+    def check(self, ctx: LintContext):
+        from .infer import element_transfer
+        for e in ctx.elements:
+            if type(e).device_fn is Element.device_fn:
+                continue  # no device_fn declared: nothing promised
+            if type(e).transform_caps is Element.transform_caps:
+                continue  # runtime path negotiates elsewhere; not comparable
+            in_caps = ctx.inference.in_caps(e)
+            known = {p: c for p, c in in_caps.items()
+                     if c is not None and c.is_fixed()}
+            if len(known) != 1:
+                continue  # gradual typing: only fire on fully-known caps
+            incaps = next(iter(known.values()))
+            try:
+                runtime = e.transform_caps(incaps)
+            except Exception:  # noqa: BLE001 -- transfer rule, not crash rule
+                continue
+            declared = element_transfer(e, in_caps)
+            for pname, dcaps in declared.items():
+                if dcaps is None or runtime is None:
+                    continue
+                if dcaps != runtime:
+                    yield self.finding(
+                        f"device_fn is declared but static transfer "
+                        f"({dcaps}) disagrees with the chain path's "
+                        f"transform_caps ({runtime}); a fused segment "
+                        f"would break byte parity", e.name, pname)
+
+
 ALL_RULES: List[Rule] = [
     DanglingPadRule(), CycleRule(), TeeNoQueueRule(), JitSignatureRule(),
     ShardingRule(), SinklessBranchRule(), CombinerDtypeRule(),
     UnboundedAdmissionRule(), LinkResilienceRule(), ErrorPolicyRule(),
-    WireConfigRule(),
+    WireConfigRule(), FusionBreakRule(), FusionTransferRule(),
 ]
 
 

@@ -34,6 +34,16 @@ class DecoderPlugin:
     def decode(self, buf: Buffer) -> Optional[Buffer]:
         raise NotImplementedError
 
+    def device_fn(self, config: Optional[TensorsConfig] = None):
+        """Optional device-side decode: a pure jax-traceable
+        ``fn(arrays) -> arrays`` equivalent of :meth:`decode` for the
+        fusion compiler, specialized to the planned input *config*
+        (shapes are static under jit, so branch on config here, not on
+        array values). Default None: the decode stays on the host.
+        Subplugins overriding this make ``tensor_decoder mode=<name>``
+        device-fusible (tools/gen_element_docs.py marks them)."""
+        return None
+
 
 def register_decoder(cls: Type[DecoderPlugin]) -> Type[DecoderPlugin]:
     if not cls.NAME:

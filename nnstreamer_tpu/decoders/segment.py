@@ -52,3 +52,34 @@ class ImageSegment(DecoderPlugin):
         b = Buffer([Chunk(np.ascontiguousarray(out))])
         b.extras["class_map"] = classes
         return b
+
+    def device_fn(self, config=None):
+        """Fused decode: argmax + palette gather + alpha select are all
+        integer/gather ops, exact under XLA and byte-identical to the
+        numpy path (argmax ties resolve first-index on both). The
+        ``class_map`` extras entry is host-side bookkeeping and is not
+        materialized on the fused path (extras carry no caps; consumers
+        needing it opt out with fuse=false)."""
+        if config is None or not len(config.info):
+            return None
+        shape = tuple(config.info[0].shape)
+        if len(shape) < 2:
+            return None
+        heatmap = len(shape) >= 3 and shape[-1] > 1
+        alpha, ncolors = self.alpha, len(_COLORS)
+        import jax.numpy as jnp
+        colors = jnp.asarray(_COLORS)
+
+        def fn(arrays):
+            arr = arrays[0]
+            if heatmap:
+                classes = jnp.argmax(arr, axis=-1)
+            else:
+                classes = arr.reshape(arr.shape[0],
+                                      arr.shape[1]).astype(jnp.int32)
+            rgb = colors[classes % ncolors]
+            a = jnp.where(classes[..., None] > 0,
+                          alpha, 0).astype(jnp.uint8)
+            return [jnp.concatenate([rgb, a], axis=-1)]
+
+        return fn

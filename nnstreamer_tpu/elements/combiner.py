@@ -57,6 +57,18 @@ class _CollectBase(Element):
     SRC_TEMPLATES = {"src": "other/tensors"}
     PROPS = {"sync-mode": "slowest", "sync-option": ""}
 
+    # -- device placement (fusion compiler) --------------------------------
+    # deliberately None: collection is stateful fan-in — per-pad queues
+    # under a condition variable, PTS time-sync policies deciding WHICH
+    # buffers pair up — so the pairing itself is host control flow. The
+    # planner also rejects it structurally (N sink pads); fusible runs
+    # resume downstream of the combined stream.
+    DEVICE_FUSIBLE = None
+
+    def device_veto(self) -> Optional[str]:
+        return ("stateful N-to-1 collection (time-sync pairing is host "
+                "control flow)")
+
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self._states: Dict[str, _PadState] = {}

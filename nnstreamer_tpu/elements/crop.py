@@ -31,6 +31,17 @@ class TensorCrop(Element):
     SRC_TEMPLATES = {"src": "other/tensors"}
     PROPS = {"lateness": -1, "silent": True}
 
+    # -- device placement (fusion compiler) --------------------------------
+    # deliberately None: crop pairs TWO streams under a lock (stateful
+    # cross-buffer queues) and emits a data-dependent number of
+    # variable-shaped chunks — none of which a static jit program can
+    # express. The planner also rejects it structurally (two sink pads).
+    DEVICE_FUSIBLE = None
+
+    def device_veto(self) -> Optional[str]:
+        return ("stateful two-stream pairing with data-dependent "
+                "output shapes")
+
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self._raw_q: Deque[Buffer] = collections.deque()

@@ -58,6 +58,32 @@ class TensorDecoder(TransformElement):
             [getattr(self, f"option{i}") for i in range(1, 10)])
         return {"src": dec.get_out_caps(caps.to_config())}
 
+    # -- device placement (fusion compiler) --------------------------------
+    DEVICE_FUSIBLE = ("modes whose subplugin declares device_fn "
+                      "(e.g. image_segment); others decode on the host")
+
+    def device_veto(self) -> Optional[str]:
+        if not self.mode:
+            return "mode not set"
+        try:
+            dec_cls = find_decoder(self.mode)
+        except ValueError:
+            return f"unknown decoder mode {self.mode!r}"
+        from ..decoders.registry import DecoderPlugin
+        if dec_cls.device_fn is DecoderPlugin.device_fn:
+            return f"decoder mode {self.mode!r} is host-only"
+        return None
+
+    def device_fn(self, ctx=None):
+        if self.device_veto() is not None:
+            return None
+        try:
+            self._open()
+        except Exception:  # noqa: BLE001 -- decline, don't block launch
+            return None
+        cfg = getattr(ctx, "in_config", None) if ctx is not None else None
+        return self._decoder.device_fn(cfg)
+
     def transform(self, buf: Buffer) -> Optional[Buffer]:
         out = self._decoder.decode(buf)
         if out is None:

@@ -326,6 +326,91 @@ class TensorFilter(Element):
             return {"src": None}  # model metadata needs the framework
         return {"src": Caps.from_config(out_cfg)}
 
+    # -- device placement (fusion compiler) --------------------------------
+    DEVICE_FUSIBLE = ("sync jax-backend invokes on static caps "
+                      "(no mesh sharding, no invoke-async/dynamic)")
+
+    _JAX_FRAMEWORKS = ("jax", "jax-tpu", "flax")
+
+    def device_veto(self) -> Optional[str]:
+        if self.invoke_async:
+            return "invoke-async: output frames are decoupled from inputs"
+        if self.invoke_dynamic:
+            return "invoke-dynamic: per-frame output shapes (dynamic caps)"
+        if "mesh:" in str(self.custom):
+            return "mesh-sharded invoke (pjit placement owns the program)"
+        fw = (self.framework or "").lower()
+        if fw in ("auto", ""):
+            first = self.model.split(",")[0] if self.model else ""
+            if not first.startswith("zoo://"):
+                return (f"framework auto-detect on {first!r} cannot be "
+                        f"proven to be the jax backend statically")
+            return None  # zoo:// always resolves to the jax backend
+        if fw not in self._JAX_FRAMEWORKS:
+            return f"framework {fw!r} exposes no traceable invoke"
+        return None
+
+    def plan_out_caps(self, incaps: Caps) -> Optional[Caps]:
+        """Plan-time refinement of :meth:`static_transfer`: opens the
+        framework (the fusion planner runs after validation, before
+        start — the one caller allowed to) and answers the same caps
+        :meth:`on_sink_caps` would negotiate, without its side
+        effects."""
+        self._open_fw()
+        cfg = incaps.to_config()
+        if cfg.format != TensorFormat.STATIC or self._out_info is None:
+            return None
+        sel = cfg.info
+        if self._in_combi:
+            sel = TensorsInfo(cfg.info[i] for i in self._in_combi)
+        batch = None
+        if self._in_info is not None and len(sel) \
+                and not sel.is_equal(self._in_info):
+            batch = self._infer_batch(sel)
+            if batch is None:
+                return None
+        out_info = self._out_info.copy()
+        if batch is not None:
+            out_info = TensorsInfo(
+                TensorInfo(i.name, i.type, (batch,) + tuple(i.shape))
+                for i in out_info)
+        return Caps.from_config(TensorsConfig(
+            out_info, TensorFormat.STATIC, cfg.rate_n, cfg.rate_d))
+
+    def device_fn(self, ctx=None):
+        """The backend's pure apply closure, wrapped with the filter's
+        input/output-combination wiring. prefetch-host is ignored for
+        MID-segment outputs (activations never leave the device, which
+        is the point); the FusedSegment honors it for the segment's
+        final outputs instead."""
+        if self.device_veto() is not None:
+            return None
+        try:
+            self._open_fw()
+        except Exception:  # noqa: BLE001 -- decline, don't block launch
+            logger.warning("%s: device_fn could not open the framework; "
+                           "staying on the chain path", self.name,
+                           exc_info=True)
+            return None
+        get = getattr(self.fw, "traceable_fn", None)
+        tr = get() if callable(get) else None
+        if tr is None:
+            return None
+        in_combi, out_combi = self._in_combi, self._out_combi
+
+        def fn(arrays):
+            xs = [arrays[i] for i in in_combi] if in_combi else list(arrays)
+            outs = tr(*xs)
+            if not isinstance(outs, (list, tuple)):
+                outs = [outs]
+            outs = list(outs)
+            if out_combi:
+                outs = [arrays[int(t[1:])] if t[0] == "i"
+                        else outs[int(t[1:])] for t in out_combi]
+            return outs
+
+        return fn
+
     def _warmup_invoke(self, sel: TensorsInfo) -> None:
         """One zero-filled invoke with the NEGOTIATED stream shapes
         (incl. any batch dim), so the jit cache is hot for the exact

@@ -193,6 +193,26 @@ class JaxFilter(FilterFramework):
             return list(out)
         return [out]
 
+    # -- fusion -----------------------------------------------------------
+    def traceable_fn(self) -> Optional[Callable]:
+        """Pure ``fn(*inputs) -> outputs`` closure over the current
+        apply/params, for the fusion compiler to inline into a larger
+        jit program (fusion/segment.py). Params are captured by value:
+        the closure stays valid across suspend/reload, it just keeps
+        serving the params it was planned with. None in mesh mode —
+        there pjit sharding owns the program placement."""
+        with self._lock:
+            if self._suspended:
+                self._resume()
+            apply_fn, params = self._apply, self._params
+            if apply_fn is None or self._mesh is not None:
+                return None
+
+        def fn(*xs):
+            return apply_fn(params, *xs)
+
+        return fn
+
     # -- events -----------------------------------------------------------
     def handle_event(self, event: FilterEvent, data=None) -> bool:
         if event == FilterEvent.CHECK_HW_AVAILABILITY:

@@ -1,0 +1,187 @@
+"""FusedSegment: one element standing in for a run of device-capable
+members, executing their composed ``device_fn`` programs as a single
+cached ``jax.jit`` per caps signature.
+
+Dataflow after rewiring (planner.apply_fusion): the upstream element
+pushes into the segment's sink pad; the segment pushes one buffer per
+input buffer from its src pad — member activations never leave the
+device between stages, so a frame crosses the host↔device link once in
+and once out instead of once per element.
+
+Caps negotiation is NOT re-implemented: the members' internal pad
+links are left intact, so the segment replays the incoming CAPS event
+through the head member's chain and lets the members' own
+``on_sink_caps`` cascade settle it (the tail's src pad is unlinked, so
+the cascade stops at the segment boundary). Whatever the unfused chain
+would have negotiated, the fused segment negotiates — by construction.
+
+Fault integration: the segment adopts the run's (uniform) ``on-error``
+policy and the strongest member circuit-breaker settings. A failure
+inside the compiled program records on the breaker and re-raises, so
+``Element.chain`` applies the policy exactly as it would for a member;
+an open breaker sheds frames with the filter's QosEvent retry-after
+convention. Stats live in the locked :class:`utils.atomic.Counters`
+(chain thread writes, user thread reads) so racecheck stays clean.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional
+
+from ..pipeline.element import TransformElement
+from ..pipeline.events import CapsEvent, QosEvent
+from ..pipeline.pad import Pad
+from ..tensors.buffer import Buffer, Chunk
+from ..utils.log import logger
+
+
+class FusedSegment(TransformElement):
+    """Composite element executing fused member programs on device.
+
+    Constructed only by the fusion planner — it is deliberately not
+    registered for launch strings (a launch string describes the
+    *unfused* graph; fusion is a start-time placement decision).
+    """
+
+    ELEMENT_NAME = "fused_segment"
+    SINK_TEMPLATES = {"sink": None}
+    SRC_TEMPLATES = {"src": None}
+    # stop()/start() drops only the jit cache; programs rebuild from
+    # the bound member fns, so on-error=restart is lossless
+    RESTART_SAFE = True
+    IS_FUSED_SEGMENT = True
+
+    def __init__(self, members: List, fns: List[Callable],
+                 name: Optional[str] = None, **props):
+        assert len(members) == len(fns) and members, "empty fused run"
+        # the run has a uniform policy (planner breaks runs otherwise);
+        # adopt it so chain-level error handling matches the members'
+        props.setdefault("on-error", str(getattr(members[0], "on_error",
+                                                 "fail")))
+        super().__init__(name, **props)
+        self.members = list(members)
+        self._fns = list(fns)
+        # a member asking for prefetch-host meant "ship my output via
+        # the coalescing fetcher"; mid-segment outputs no longer leave
+        # the device, but the SEGMENT's output does — honor the intent
+        # there
+        self._prefetch = any(bool(getattr(m, "prefetch_host", False))
+                             for m in members)
+        # per-caps-signature compiled programs; only the segment's
+        # streaming thread touches it (one segment = one thread)
+        self._programs: dict = {}
+        self.stats.update(jit_hits=0, jit_misses=0, shed=0,
+                          breaker_opened=0, fused_elements=len(members))
+        # strongest member breaker settings win; 0 threshold = no breaker
+        self._breaker = None
+        self.breaker_threshold = max(
+            (int(getattr(m, "breaker_threshold", 0) or 0) for m in members),
+            default=0)
+        resets = [float(getattr(m, "breaker_reset_ms", 0) or 0)
+                  for m in members
+                  if int(getattr(m, "breaker_threshold", 0) or 0) > 0]
+        self.breaker_reset_ms = min(resets) if resets else 1000.0
+        retries = [float(getattr(m, "breaker_retry_after_ms", 0) or 0)
+                   for m in members]
+        self.breaker_retry_after_ms = max(retries) if retries else 100.0
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> None:
+        super().start()
+        if int(self.breaker_threshold) > 0:
+            from ..fault.breaker import CircuitBreaker
+            self._breaker = CircuitBreaker(
+                threshold=int(self.breaker_threshold),
+                reset_s=float(self.breaker_reset_ms) / 1e3,
+                name=self.name, on_transition=self._on_breaker_transition)
+        else:
+            self._breaker = None
+
+    def stop(self) -> None:
+        super().stop()
+        self._programs.clear()
+
+    def _on_breaker_transition(self, old: str, new: str) -> None:
+        from ..fault.breaker import OPEN
+        if new == OPEN:
+            self.stats.inc("breaker_opened")
+        logger.warning("%s: circuit breaker %s -> %s", self.name, old, new)
+        self.post_message("warning", breaker=new, breaker_from=old,
+                          retry_after_ms=float(self.breaker_retry_after_ms))
+
+    # -- negotiation ------------------------------------------------------
+    def on_sink_caps(self, pad: Pad, caps) -> None:
+        """Replay the CAPS event through the members' own negotiation
+        (their internal links are intact; the tail's unlinked src pad
+        ends the cascade), then forward the tail's answer."""
+        head, tail = self.members[0], self.members[-1]
+        head.chain(head.sinkpad, CapsEvent(caps))
+        out = None
+        for p in tail.src_pads.values():
+            if p.caps is not None:
+                out = p.caps
+                break
+        if out is None:
+            raise ValueError(
+                f"{self.name}: member negotiation produced no caps for "
+                f"{caps} (members: {[m.name for m in self.members]})")
+        self.set_src_caps(out)
+
+    # -- dataflow ---------------------------------------------------------
+    def do_chain(self, pad: Pad, buf: Buffer) -> None:
+        if self._breaker is not None and not self._breaker.allow():
+            self._shed_frame(buf)
+            return
+        arrays = [c.raw for c in buf.chunks]
+        sig = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+        t0 = time.perf_counter_ns()
+        exe = self._programs.get(sig)
+        if exe is None:
+            self.stats.inc("jit_misses")
+            exe = self._compile()
+        else:
+            self.stats.inc("jit_hits")
+        try:
+            outs = exe(arrays)
+        except Exception:
+            # device program failed (trace or dispatch): count it on
+            # the breaker, then let Element.chain apply the segment's
+            # on-error policy — exactly the member path's fault flow
+            if self._breaker is not None:
+                self._breaker.record_failure()
+            raise
+        self._programs[sig] = exe
+        if self._breaker is not None:
+            self._breaker.record_success()
+        dt = time.perf_counter_ns() - t0
+        tracer = getattr(self.pipeline, "tracer", None)
+        if tracer is not None:
+            tracer.observe(f"fusion/{self.name}", dt)
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        if self._prefetch:
+            from ..tensors.fetch import submit_fetch
+            outs = submit_fetch(outs)
+        self.push(buf.with_chunks([Chunk(o) for o in outs]))
+
+    def _compile(self):
+        import jax
+        fns = self._fns
+
+        def program(arrs):
+            for fn in fns:
+                arrs = fn(arrs)
+            return arrs
+
+        # one jax.jit object per caps signature: jit would retrace a
+        # shared object silently, which would skew the hit/miss stats
+        # the trace report promises
+        return jax.jit(program)
+
+    def _shed_frame(self, buf: Buffer) -> None:
+        self.stats.inc("shed")
+        self.stats.inc("dropped")
+        self.send_upstream_event(QosEvent(
+            proportion=2.0,
+            period_ns=int(float(self.breaker_retry_after_ms) * 1e6),
+            timestamp=buf.pts))

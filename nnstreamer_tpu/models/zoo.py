@@ -52,6 +52,32 @@ def jit_init(model, seed: str, dummy):
     return jax.jit(model.init)(jax.random.PRNGKey(int(seed)), dummy)
 
 
+@register_model("toyseg")
+def _build_toyseg(height: str = "8", width: str = "8", classes: str = "5",
+                  seed: str = "0"):
+    """Toy per-pixel segmenter: [H, W] float32 -> [H, W, C] logits via
+    per-class elementwise scale+shift. Deliberately elementwise-only
+    (no matmul/conv, no reductions) so its outputs are bit-exact across
+    XLA fusion decisions — the model the fusion compiler's byte-parity
+    oracle leans on for filter->decoder chains."""
+    import jax
+    import jax.numpy as jnp
+
+    h, w, c = int(height), int(width), int(classes)
+    k1, k2 = jax.random.split(jax.random.PRNGKey(int(seed)))
+    params = {
+        "scale": jax.random.normal(k1, (c,), jnp.float32),
+        "shift": jax.random.normal(k2, (c,), jnp.float32),
+    }
+
+    def apply_fn(p, x):
+        return x.astype(jnp.float32)[..., None] * p["scale"] + p["shift"]
+
+    in_info = TensorsInfo.make("float32", f"{h}:{w}")
+    out_info = TensorsInfo.make("float32", f"{h}:{w}:{c}")
+    return apply_fn, params, in_info, out_info
+
+
 @register_model("mlp")
 def _build_mlp(in_dim: str = "64", hidden: str = "128", out_dim: str = "10",
                seed: str = "0", dtype: str = "bfloat16"):

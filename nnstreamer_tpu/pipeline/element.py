@@ -264,6 +264,40 @@ class Element:
             return {p: caps for p in self.src_pads}
         return {p: None for p in self.src_pads}
 
+    # -- device placement (fusion compiler) -------------------------------
+    # one-line capability note for docs/pipelint: None means the element
+    # never provides a device function; a string describes when it does
+    # (see Documentation/fusion.md and fusion/planner.py)
+    DEVICE_FUSIBLE: Optional[str] = None
+
+    def device_veto(self) -> Optional[str]:
+        """Static reason this element can NOT provide a device function,
+        or None when :meth:`device_fn` is expected to return a program.
+        Declared next to :meth:`static_transfer` and held to the same
+        discipline: pipelint calls it, so it must never open models,
+        sockets, or devices. The planner still calls :meth:`device_fn`
+        afterwards (which may decline with None for config-specific
+        reasons)."""
+        if type(self).device_fn is Element.device_fn:
+            return "no device function"
+        return None
+
+    def device_fn(self, ctx=None):
+        """Pure, traceable device-side body of this element, or None.
+
+        Returns a callable ``fn(arrays: List[Array]) -> List[Array]``
+        mapping the chunks of one input buffer to the chunks of one
+        output buffer, composed of jax-traceable ops only (no Python
+        side effects, no host round trips) — the fusion planner
+        composes consecutive members' fns into one ``jax.jit`` program
+        (fusion/segment.py). ``ctx`` is a :class:`fusion.FusionCtx`
+        carrying the statically planned input caps/config. Unlike
+        :meth:`device_veto` this runs at plan time (after validation,
+        before start) and MAY open the element's model/subplugin; return
+        None to decline, and the element keeps its per-buffer chain
+        path."""
+        return None
+
     def set_src_caps(self, caps: Caps, pad: Optional[Pad] = None) -> None:
         pads = [pad] if pad is not None else list(self.src_pads.values())
         for p in pads:

@@ -137,6 +137,14 @@ def parse_launch(desc: str, pipeline: Optional[Pipeline] = None) -> Pipeline:
             elem = make_element("capsfilter", caps=_unquote(tok))
         else:
             if m:
+                # pipeline-level props: a leading KEY=VALUE before any
+                # element configures the Pipeline itself (gst-launch has
+                # no analog; we use it for the fusion opt-out:
+                # ``fuse=false src ! ...``).
+                if current is None and m.group(1) == "fuse":
+                    pipe.fuse = _unquote(m.group(2)).lower() not in (
+                        "false", "0", "no", "off")
+                    continue
                 raise _err(i, f"property {tok!r} with no element to "
                               f"apply to")
             try:

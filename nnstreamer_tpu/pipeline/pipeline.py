@@ -60,6 +60,12 @@ class Pipeline:
         # pre-PLAYING static validation gate (pipelint); set False to
         # launch a pipeline the analyzer rejects (escape hatch)
         self.validate_on_start = True
+        # fusion compiler (fusion/): compile maximal device-capable runs
+        # into FusedSegments at start. ``fuse=false`` as a pipeline-level
+        # launch prop (or this attr) keeps the per-element chain path —
+        # the parity oracle and the escape hatch.
+        self.fuse = True
+        self._fusion_plan = None
 
     def enable_tracing(self):
         """Attach a Tracer (≙ GstShark proctime/interlatency/framerate
@@ -135,6 +141,13 @@ class Pipeline:
                 raise PipelineValidationError(report)
             for f in report.warnings:
                 logger.warning("pipelint: %s", f)
+        if self.fuse and self._fusion_plan is None:
+            from ..fusion import fuse_pipeline
+            try:
+                self._fusion_plan = fuse_pipeline(self)
+            except Exception:  # noqa: BLE001 -- never block launch on fusion
+                logger.warning(
+                    "fusion: planner failed; running unfused", exc_info=True)
         self._sinks_eos.clear()
         self._eos_evt.clear()
         self._error = None

@@ -197,4 +197,43 @@ class Tracer:
                 q = getattr(el, "_q", None)
                 if q is not None and hasattr(q, "qsize"):
                     entry["queue_level"] = q.qsize()
+            fusion = self._fusion_block(pipeline, out)
+            if fusion:
+                out["fusion"] = fusion
         return out
+
+    @staticmethod
+    def _fusion_block(pipeline, report: Dict[str, Dict[str, Any]]
+                      ) -> Dict[str, Any]:
+        """Aggregate fusion-compiler stats: one sub-entry per
+        FusedSegment (member count, jit cache hits/misses, p50 of the
+        device-program dispatch latency observed as ``fusion/<name>``)
+        plus pipeline totals. {} on unfused pipelines so existing
+        reports are unchanged."""
+        segments: Dict[str, Any] = {}
+        for name, el in pipeline.elements.items():
+            if not getattr(el, "IS_FUSED_SEGMENT", False):
+                continue
+            st = el.stats.snapshot()
+            seg = {
+                "elements": st.get("fused_elements", 0),
+                "members": [m.name for m in getattr(el, "members", [])],
+                "jit_hits": st.get("jit_hits", 0),
+                "jit_misses": st.get("jit_misses", 0),
+            }
+            # the dispatch-latency series is internal plumbing; fold it
+            # into the segment entry instead of a top-level row
+            series = report.pop(f"fusion/{name}", None)
+            if series is not None:
+                seg["dispatch_us_p50"] = series["interlatency_us_p50"]
+                seg["dispatch_us_p95"] = series["interlatency_us_p95"]
+            segments[name] = seg
+        if not segments:
+            return {}
+        return {
+            "segments": len(segments),
+            "fused_elements": sum(s["elements"] for s in segments.values()),
+            "jit_hits": sum(s["jit_hits"] for s in segments.values()),
+            "jit_misses": sum(s["jit_misses"] for s in segments.values()),
+            "per_segment": segments,
+        }

@@ -1,0 +1,217 @@
+#!/usr/bin/env python
+"""Fusion byte-parity gate: fused output must equal the chain path.
+
+Runs every runnable pipeline description the repo's corpus yields
+(tests/*.py string literals + README.md code blocks, extracted by
+tools/lint_corpus.py) twice — once with the fusion compiler on, once
+with ``fuse=false`` — and compares every sink's output byte-for-byte
+(dtype, shape, raw bytes, per buffer, per chunk). A built-in
+representative suite (filter→decoder, transform chains, mux fan-in,
+crop fan-out) always runs, so the gate tests something even if the
+extracted corpus yields no fusible pipelines.
+
+Corpus descriptions are filtered, not fixed: anything that needs a
+network peer, a file on disk, an unbounded source, or a non-jax
+framework is skipped (counted). Exit status is nonzero iff any pipeline
+that fused produced bytes differing from its unfused twin.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+import numpy as np  # noqa: E402
+
+from tools.lint_corpus import collect  # noqa: E402
+
+# kinds that run hermetically on this host: no sockets, no files, no
+# hardware, no wall-clock coupling
+_RUNNABLE = {
+    "tensortestsrc", "capsfilter", "identity", "queue", "tee",
+    "tensor_converter", "tensor_transform", "tensor_filter",
+    "tensor_decoder", "tensor_mux", "tensor_demux", "tensor_merge",
+    "tensor_crop", "tensor_split", "tensor_aggregator", "tensor_rate",
+    "appsink", "fakesink", "tensor_sink",
+}
+
+# the always-on representative suite (kept in sync with
+# tests/test_fusion.py's parity cases)
+_CAPS_U8 = ("other/tensors,format=static,num_tensors=1,"
+            "types=(string)uint8,dimensions=(string)3:4:4,"
+            "framerate=(fraction)0/1")
+_CAPS_SEG = ("other/tensors,format=static,num_tensors=1,"
+             "types=(string)float32,dimensions=(string)8:8,"
+             "framerate=(fraction)0/1")
+_CAPS_INFO = ("other/tensors,format=static,num_tensors=1,"
+              "types=(string)uint32,dimensions=(string)4,"
+              "framerate=(fraction)0/1")
+BUILTIN = [
+    ("builtin:filter-decoder",
+     f"tensortestsrc caps={_CAPS_SEG} num-buffers=4 ! "
+     "tensor_filter framework=jax model=zoo://toyseg ! "
+     "tensor_decoder mode=image_segment ! appsink name=out"),
+    ("builtin:transform-chain",
+     f"tensortestsrc caps={_CAPS_U8} num-buffers=4 ! "
+     "tensor_transform mode=typecast option=float32 ! "
+     "tensor_transform mode=arithmetic option=mul:2,add:1 ! "
+     "tensor_transform mode=transpose option=1:0:2 ! appsink name=out"),
+    ("builtin:mux-transform",
+     "tensor_mux name=m ! "
+     "tensor_transform mode=typecast option=float32 ! "
+     "tensor_transform mode=arithmetic option=div:2 ! appsink name=out "
+     f"tensortestsrc caps={_CAPS_U8} num-buffers=3 ! m.sink_0 "
+     f"tensortestsrc caps={_CAPS_U8} num-buffers=3 ! m.sink_1"),
+    ("builtin:transform-crop",
+     "tensor_crop name=c ! appsink name=out "
+     f"tensortestsrc caps={_CAPS_U8} num-buffers=5 ! "
+     "tensor_transform mode=typecast option=float32 ! "
+     "tensor_transform mode=arithmetic option=mul:2 ! c.raw "
+     f"tensortestsrc caps={_CAPS_INFO} num-buffers=5 ! c.info"),
+]
+
+_MAX_BUFFERS = 4  # forced bound for corpus sources left unbounded
+
+
+def _runnable(pipe) -> Optional[str]:
+    """None when every element can run hermetically, else the reason."""
+    from nnstreamer_tpu.analysis.rules import kind_of
+    for e in pipe.elements.values():
+        kind = kind_of(e)
+        if kind not in _RUNNABLE:
+            return f"kind {kind!r} is not hermetic"
+        if kind == "tensor_filter":
+            fw = (str(e.framework) or "").lower()
+            model = str(e.model).split(",")[0]
+            if not model.startswith("zoo://"):
+                return f"model {model!r} needs files on disk"
+            if fw not in ("", "auto", "jax", "jax-tpu", "flax"):
+                return f"framework {fw!r} is not baked in"
+    return None
+
+
+def _bound_sources(pipe) -> None:
+    from nnstreamer_tpu.pipeline.element import SrcElement
+    for e in pipe.elements.values():
+        if isinstance(e, SrcElement):
+            if int(getattr(e, "num_buffers", -1) or -1) <= 0:
+                e.set_property("num-buffers", _MAX_BUFFERS)
+            if bool(getattr(e, "is_live", False)):
+                e.set_property("is-live", False)
+
+
+def _capture_sinks(pipe) -> Dict[str, List[Tuple]]:
+    """Per-sink recorder: wraps each sink's render() so every pipeline
+    output — not just appsink's — is byte-compared."""
+    from nnstreamer_tpu.pipeline.element import SinkElement
+    got: Dict[str, List[Tuple]] = {}
+
+    def _wrap(sink, rec):
+        orig = sink.render
+
+        def render(buf):
+            rec.append(tuple(
+                (str(np.asarray(c.host()).dtype),
+                 tuple(np.asarray(c.host()).shape),
+                 np.ascontiguousarray(c.host()).tobytes())
+                for c in buf.chunks))
+            return orig(buf)
+
+        sink.render = render
+
+    for name, e in pipe.elements.items():
+        if isinstance(e, SinkElement):
+            got[name] = []
+            _wrap(e, got[name])
+    return got
+
+
+def _run_once(desc: str, fuse: bool, timeout: float):
+    from nnstreamer_tpu.pipeline.parser import parse_launch
+    pipe = parse_launch(desc)
+    pipe.fuse = fuse
+    _bound_sources(pipe)
+    got = _capture_sinks(pipe)
+    pipe.run(timeout=timeout)
+    fused = [e.name for e in pipe.elements.values()
+             if getattr(e, "IS_FUSED_SEGMENT", False)]
+    return got, fused
+
+
+def check_parity(where: str, desc: str, timeout: float = 60.0
+                 ) -> Tuple[str, str]:
+    """-> (status, detail); status in {fused-ok, unfused, skipped, FAIL}."""
+    from nnstreamer_tpu.analysis import analyze
+    from nnstreamer_tpu.fusion import plan_fusion
+    from nnstreamer_tpu.pipeline.parser import parse_launch
+    try:
+        probe = parse_launch(desc)
+    except ValueError as exc:
+        return "skipped", f"not a pipeline: {exc}"
+    reason = _runnable(probe)
+    if reason is not None:
+        return "skipped", reason
+    if analyze(probe).errors:
+        return "skipped", "pipelint rejects it (validation gate)"
+    try:
+        if not plan_fusion(probe).segments:
+            return "unfused", "planner finds nothing to fuse"
+    except Exception as exc:  # noqa: BLE001 -- report, don't crash the gate
+        return "FAIL", f"planner crashed: {exc!r}"
+    try:
+        fused_out, fused = _run_once(desc, fuse=True, timeout=timeout)
+        plain_out, _ = _run_once(desc, fuse=False, timeout=timeout)
+    except Exception as exc:  # noqa: BLE001
+        return "FAIL", f"run crashed: {exc!r}"
+    if not fused:
+        return "FAIL", "planner fused the probe but not the live run"
+    for sink in plain_out:
+        if fused_out.get(sink) != plain_out[sink]:
+            na, nb = len(fused_out.get(sink, [])), len(plain_out[sink])
+            return "FAIL", (f"sink {sink!r}: fused bytes differ from the "
+                            f"chain path ({na} vs {nb} buffers)")
+    nbuf = sum(len(v) for v in plain_out.values())
+    return "fused-ok", f"{len(fused)} segment(s), {nbuf} buffers identical"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", help="files to scan (default: "
+                    "tests/*.py and README.md)")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    ap.add_argument("--timeout", type=float, default=60.0)
+    opts = ap.parse_args(argv)
+
+    paths = ([Path(p) for p in opts.paths] if opts.paths else
+             sorted(ROOT.glob("tests/*.py")) + [ROOT / "README.md"])
+    candidates = BUILTIN + collect(paths)
+
+    counts = {"fused-ok": 0, "unfused": 0, "skipped": 0, "FAIL": 0}
+    failures: List[str] = []
+    seen = set()
+    for where, desc in candidates:
+        if desc in seen:
+            continue
+        seen.add(desc)
+        status, detail = check_parity(where, desc, timeout=opts.timeout)
+        counts[status] += 1
+        if status == "FAIL":
+            failures.append(f"{where}: {detail}\n    {desc}")
+        if opts.verbose or status == "FAIL":
+            print(f"[{status}] {where}: {detail}")
+    print(f"fuse-parity: {counts['fused-ok']} pipelines byte-identical, "
+          f"{counts['unfused']} had nothing to fuse, "
+          f"{counts['skipped']} skipped, {counts['FAIL']} failures")
+    if counts["fused-ok"] == 0:
+        print("fuse-parity: BUILTIN suite did not fuse — the gate is "
+              "vacuous", file=sys.stderr)
+        return 1
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

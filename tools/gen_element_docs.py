@@ -73,6 +73,10 @@ def main() -> int:
             out.append("")
         out.append(f"**Caps transfer (pipelint):** {_transfer_doc(cls)}")
         out.append("")
+        fusible = getattr(cls, "DEVICE_FUSIBLE", None)
+        if fusible:
+            out.append(f"**Device-fusible (fusion compiler):** {fusible}")
+            out.append("")
         props = {}
         for klass in reversed(cls.__mro__):
             props.update(getattr(klass, "PROPS", {}))
