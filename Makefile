@@ -9,7 +9,8 @@ SHELL := /bin/bash
 LIB := $(BUILD)/libnnstpu.so
 EXAMPLES := $(BUILD)/custom_passthrough.so $(BUILD)/custom_scaler.so
 
-.PHONY: native clean test check tier1 lint racecheck chaos fuse-parity package
+.PHONY: native clean test check tier1 lint racecheck chaos chaos-zeroloss \
+	fuse-parity package
 
 native: $(LIB) $(EXAMPLES)
 
@@ -30,11 +31,18 @@ check: native lint racecheck
 fuse-parity:
 	env JAX_PLATFORMS=cpu python tools/fuse_parity.py
 
-# `make chaos` = the full fault-injection harness including the slow
-# seeded serve-pipeline schedules (excluded from tier-1 by the slow
-# marker; run on demand and at the end of `make check`).
+# `make chaos` = the full fault-injection harness: the slow seeded
+# serve-pipeline schedules (excluded from tier-1 by the slow marker)
+# plus the zero-loss link-kill/peer-kill scenarios — sessions must
+# survive >=3 mid-stream kills (incl. mid-DATA_BATCH) with exact
+# accounting. Run on demand and at the end of `make check`.
 chaos:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q
+
+# just the zero-loss acceptance scenarios (fast; they also run in tier-1)
+chaos-zeroloss:
+	env JAX_PLATFORMS=cpu python -m pytest \
+		tests/test_chaos.py::TestZeroLossChaos -q
 
 # `make tier1` = the exact ROADMAP.md tier-1 verify gate, verbatim
 # (timeout, log tee, pass-dot count and all).

@@ -715,6 +715,79 @@ def bench_wire_row() -> dict:
     return out
 
 
+def bench_chaos_zeroloss_row(n_frames: int = 60, every: int = 10) -> dict:
+    """Chaos row (ISSUE 7 acceptance): a session edge link with seeded
+    kill-link faults injected mid-stream — while the publisher coalesces
+    frames into DATA_BATCH, so kills land with partially-consumed
+    batches in flight. The row records throughput under chaos plus the
+    exact delivery accounting; ``verdict`` is "zero-loss" only when
+    every stamped frame arrived exactly once, in order, with nothing
+    declared lost on either end and resumes == kills."""
+    import socket as _socket
+
+    import numpy as np
+
+    from nnstreamer_tpu import Buffer, parse_launch
+
+    caps = ("other/tensors,format=static,num_tensors=1,"
+            "types=(string)float32,dimensions=(string)4")
+    s = _socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    pub = parse_launch(
+        f'appsrc name=in caps="{caps}" '
+        f'! edgesink name=p port={port} topic=bench session=true '
+        'coalesce-frames=4 coalesce-ms=10')
+    pub.start()
+    time.sleep(0.2)
+    sub = parse_launch(
+        f'edgesrc name=s dest-port={port} topic=bench session=true '
+        'ack-every=4 timeout=15 '
+        f'! tensor_fault name=f mode=kill-link target=s every={every} '
+        'seed=7 ! appsink name=out')
+    sub.start()
+    time.sleep(0.3)
+    t0 = time.perf_counter()
+    for i in range(n_frames):
+        pub["in"].push_buffer(Buffer.from_arrays(
+            [np.full(4, float(i), np.float32)]))
+        time.sleep(0.01)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline \
+            and len(sub["out"].buffers) < n_frames:
+        time.sleep(0.05)
+    wall = time.perf_counter() - t0
+    vals = [float(b.chunks[0].host()[0]) for b in sub["out"].buffers]
+    kills = sub["f"].stats["faults"]
+    ps = pub["p"].stats.snapshot()
+    ss = sub["s"].stats.snapshot()
+    aborted = pub._error is not None or sub._error is not None
+    pub["in"].end_stream()
+    pub.stop()
+    sub.stop()
+    zero_loss = (not aborted
+                 and vals == [float(i) for i in range(n_frames)]
+                 and ps["session_sent"] == n_frames
+                 and ss["session_delivered"] == n_frames
+                 and ps["session_declared_lost"] == 0
+                 and ss["session_declared_lost"] == 0
+                 and ps["session_resumes"] == kills
+                 and ss["reconnects"] == kills)
+    return {"chaos_zeroloss": {
+        "frames": n_frames,
+        "link_kills": int(kills),
+        "fps_under_chaos": round(n_frames / wall, 1) if wall else None,
+        "delivered": int(ss["session_delivered"]),
+        "declared_lost": int(ps["session_declared_lost"]
+                             + ss["session_declared_lost"]),
+        "replayed": int(ps["session_replayed"]),
+        "dup_drops": int(ss["session_dup_drops"]),
+        "resumes": int(ps["session_resumes"]),
+        "verdict": "zero-loss" if zero_loss else "LOST-FRAMES",
+    }}
+
+
 # -- device-resident invoke rows (measured-FLOP MFU) --------------------------
 
 def _compiled_flops(jf, *args) -> float:
@@ -1117,6 +1190,15 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001
         print(f"# wire row failed: {e}", file=sys.stderr)
         extras["wire_bytes_reduction_pct"] = None
+
+    # chaos row: a session edge link under seeded mid-stream link kills
+    # must deliver every frame exactly once (ISSUE 7). Host-side only,
+    # comparative against its own accounting, so not weather-adjudicated.
+    try:
+        extras.update(bench_chaos_zeroloss_row())
+    except Exception as e:  # noqa: BLE001
+        print(f"# chaos zero-loss row failed: {e}", file=sys.stderr)
+        extras["chaos_zeroloss"] = None
 
     # separate traced pass: tracer bookkeeping must not sit inside the
     # timed region of the fps row above. Long enough (120 frames vs ~40

@@ -534,11 +534,73 @@ class FusionTransferRule(Rule):
                         f"would break byte parity", e.name, pname)
 
 
+class SessionReplayBudgetRule(Rule):
+    """An edgesink replay ring smaller than ONE coalesced batch cannot
+    replay even the minimal unit of loss: the very first reconnect gap
+    is guaranteed to contain declared-lost frames. That configuration
+    can never deliver the zero-loss promise session=true makes, so it
+    is an error, not a tuning warning."""
+
+    id = "session-replay-budget"
+    severity = Severity.ERROR
+
+    def check(self, ctx: LintContext):
+        import numpy as np
+        for e in ctx.of_kind("edgesink"):
+            if not bool(getattr(e, "session", False)):
+                continue
+            ring_bytes = int(getattr(e, "session_ring_kb", 0)) * 1024
+            frames = max(1, int(getattr(e, "coalesce_frames", 1)))
+            pad = e.sink_pads.get("sink")
+            if pad is None or pad.peer is None:
+                continue
+            cfg = config_of(ctx.inference.pad_caps.get(pad.peer))
+            if cfg is None or cfg.format != TensorFormat.STATIC \
+                    or not len(cfg.info):
+                continue  # gradual typing: only fire on provable frames
+            try:
+                frame_bytes = sum(
+                    int(np.prod(i.shape)) * np.dtype(i.type.np_dtype).itemsize
+                    for i in cfg.info)
+            except (TypeError, ValueError):
+                continue
+            batch_bytes = frames * frame_bytes
+            if frame_bytes > 0 and ring_bytes < batch_bytes:
+                yield self.finding(
+                    f"session replay ring ({ring_bytes} B) is smaller than "
+                    f"one coalesced batch ({frames} frame(s) x "
+                    f"{frame_bytes} B = {batch_bytes} B): the first "
+                    f"reconnect gap is GUARANTEED to declare lost frames; "
+                    f"raise session-ring-kb or lower coalesce-frames",
+                    e.name, "sink")
+
+
+class SessionNoReconnectRule(Rule):
+    """session=true buys replay-on-RESUME — but RESUME only happens on a
+    re-dial. With reconnect=false a dropped link just ends the stream as
+    EOS and the session's replay ring never gets asked, so the operator
+    is paying for acks with no delivery guarantee in return."""
+
+    id = "session-no-reconnect"
+    severity = Severity.WARNING
+
+    def check(self, ctx: LintContext):
+        for e in ctx.of_kind("edgesrc"):
+            if bool(getattr(e, "session", False)) \
+                    and not bool(getattr(e, "reconnect", True)):
+                yield self.finding(
+                    "session=true with reconnect=false: a dropped link "
+                    "ends the stream before any RESUME can replay the "
+                    "gap — the session guarantees nothing; enable "
+                    "reconnect or drop the session overhead", e.name)
+
+
 ALL_RULES: List[Rule] = [
     DanglingPadRule(), CycleRule(), TeeNoQueueRule(), JitSignatureRule(),
     ShardingRule(), SinklessBranchRule(), CombinerDtypeRule(),
     UnboundedAdmissionRule(), LinkResilienceRule(), ErrorPolicyRule(),
     WireConfigRule(), FusionBreakRule(), FusionTransferRule(),
+    SessionReplayBudgetRule(), SessionNoReconnectRule(),
 ]
 
 

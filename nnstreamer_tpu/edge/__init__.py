@@ -8,8 +8,11 @@ in-pod scale-out instead uses jax.sharding over ICI (parallel/).
 from .broker import DiscoveryBroker, discover
 from .mqtt import MqttBroker
 from .protocol import MsgKind, recv_msg, send_msg
+from .session import (Heartbeat, ReplayRing, SessionConfig, SessionReceiver,
+                      new_session_id)
 from .wire import WireConfig, accept, advertise, negotiate, tune_socket
 
 __all__ = ["MsgKind", "send_msg", "recv_msg", "DiscoveryBroker", "discover",
            "MqttBroker", "WireConfig", "advertise", "negotiate", "accept",
-           "tune_socket"]
+           "tune_socket", "SessionConfig", "SessionReceiver", "ReplayRing",
+           "Heartbeat", "new_session_id"]

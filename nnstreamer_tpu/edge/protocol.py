@@ -73,6 +73,15 @@ class MsgKind(enum.IntEnum):
                     # deadline); meta carries retry_after_ms + seq
     DATA_BATCH = 13  # N coalesced DATA frames in one message (wire v2
                      # only: meta template + per-frame binary header)
+    # session layer (edge/session.py) — only ever sent on links that
+    # negotiated a session at CAPS/SUBSCRIBE; a v1 peer never sees them
+    ACK = 14        # receiver -> sender: cumulative delivery watermark
+    RESUME = 15     # reconnecting receiver: {sid, last delivered seq}
+    RESUME_ACK = 16  # sender's answer: {resumed, frames_lost, base}
+    PING = 17       # liveness probe across an idle link
+    PONG = 18       # echo of the PING's timestamp
+    DRAIN = 19      # graceful teardown: admission is closing; in-flight
+                    # frames flush + settle before the peer goes away
 
 
 def resolve_dtype(name: str) -> np.dtype:
@@ -108,6 +117,24 @@ def as_payload_view(p: Payload) -> Union[bytes, memoryview]:
     if isinstance(p, (bytearray, memoryview)):
         return memoryview(p).cast("B")
     return p
+
+
+def sever_socket(sock: Optional[socket.socket]) -> None:
+    """Force-close a live socket so BOTH ends notice immediately.
+    shutdown() must precede close(): a thread blocked in recv() on this
+    socket holds a kernel reference, so a bare close() would neither
+    wake it nor send FIN — the peer's select() would wait forever on a
+    connection that is dead only in name."""
+    if sock is None:
+        return
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
 
 
 def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:

@@ -197,6 +197,17 @@ class TensorFilter(Element):
         else:
             self._breaker = None
 
+    def drain(self) -> None:
+        """During a deliberate drain the filter may sit idle for longer
+        than the suspend window while upstream flushes its queues —
+        quiesce the idle watchdog so the model is not unloaded right
+        before the flushed tail arrives and needs it. (The pipeline
+        stops after the drain, so the quiesce is never resumed: destroy
+        in stop() cleans up.)"""
+        super().drain()
+        if self._watchdog is not None:
+            self._watchdog.quiesce()
+
     def stop(self) -> None:
         super().stop()
         if self._watchdog is not None:

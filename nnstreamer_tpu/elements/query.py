@@ -20,7 +20,7 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from ..edge import wire
-from ..edge.protocol import MsgKind, recv_msg, send_msg
+from ..edge.protocol import MsgKind, recv_msg, send_msg, sever_socket as _sever
 from ..pipeline.element import Element, SinkElement, SrcElement
 from ..pipeline.events import QosEvent
 from ..pipeline.pad import Pad
@@ -77,6 +77,11 @@ class _ServerTable:
         with self._lock:
             return self._out_caps.get(server_id)
 
+    def conns_of(self, server_id: int) -> list:
+        """Live client sockets of one server (drain notification)."""
+        with self._lock:
+            return [s for k, s in self._conns.items() if k[0] == server_id]
+
     def close_server(self, server_id: int) -> None:
         """Close every client connection of a stopping server so clients
         see the death immediately and can fail over."""
@@ -87,10 +92,7 @@ class _ServerTable:
                 del self._conns[k]
                 self._wire.pop(k, None)
         for _, s in victims:
-            try:
-                s.close()
-            except OSError:
-                pass
+            _sever(s)
 
 
 SERVER_TABLE = _ServerTable()
@@ -257,7 +259,39 @@ class TensorQueryServerSrc(SrcElement):
             except OSError:
                 pass
 
+    def drain(self) -> None:
+        """Graceful teardown: stop admitting frames (late arrivals are
+        shed + counted), tell every client DRAIN so it stops sending,
+        and flush the queue through the pipeline behind the EOS barrier
+        — every queued frame still gets its RESULT before close."""
+        super().drain()
+        for conn in SERVER_TABLE.conns_of(self.id):
+            try:
+                send_msg(conn, MsgKind.DRAIN, {"server_id": self.id})
+            except (ConnectionError, OSError):
+                pass
+        with self._qlock:
+            self._qlock.notify_all()
+
+    def drain_flushed(self) -> bool:
+        with self._qlock:
+            return not self._queue
+
+    def kill_link(self) -> int:
+        """Chaos hook (tensor_fault mode=kill-link): force-close every
+        live client connection mid-stream; clients reconnect and replay
+        their unanswered frames."""
+        victims = len(SERVER_TABLE.conns_of(self.id))
+        SERVER_TABLE.close_server(self.id)
+        self.stats.inc("link_kills", victims)
+        return victims
+
     def _enqueue(self, buf: Buffer, cid: int) -> None:
+        if self._drain_evt.is_set():
+            # admission is closed: the frame is shed, visibly — the
+            # client's pending entry settles via its own teardown path
+            self.stats.inc("shed")
+            return
         buf.extras["client_id"] = cid
         buf.extras["server_id"] = self.id
         with self._qlock:
@@ -269,6 +303,8 @@ class TensorQueryServerSrc(SrcElement):
             while not self._queue:
                 if self._stop_evt.is_set():
                     return None
+                if self._drain_evt.is_set():
+                    return None  # drained dry: the EOS barrier
                 self._qlock.wait(timeout=0.1)
             k = int(self.batch)
             if k <= 1:
@@ -416,7 +452,16 @@ class TensorQueryClient(Element):
         # RIGHT pending entry; plain query servers ignore it and the
         # client falls back to FIFO pairing
         self._seq = 0
-        self.stats.update({"reconnects": 0, "shed": 0})
+        # exact request accounting (the satellite fix for swallowed
+        # frames): every admitted frame ends in exactly one bucket, so
+        #   session_requests == session_delivered + shed
+        #                       + session_declared_lost + in-flight
+        # always balances — a frame that dies between socket-error
+        # detection and re-dial is DECLARED, never silently swallowed
+        self.stats.update({"reconnects": 0, "shed": 0,
+                           "session_requests": 0, "session_delivered": 0,
+                           "session_replayed": 0, "session_dup_drops": 0,
+                           "session_declared_lost": 0})
 
     def static_transfer(self, in_caps):
         """Unknown output: result caps come from the remote server."""
@@ -531,6 +576,7 @@ class TensorQueryClient(Element):
                     send_msg(sock, MsgKind.DATA, meta, payloads,
                              stats=self.stats)
                     entry[2] = gen
+                    self.stats.inc("session_replayed")
             return True
         except (ConnectionError, OSError):
             self._handle_disconnect(sock)
@@ -551,11 +597,7 @@ class TensorQueryClient(Element):
             # fresh permit pool: replies owed on the dead connection will
             # never come, and blocked senders must not burn the timeout
             self._inflight = threading.Semaphore(max(1, self.max_request))
-        if old is not None:
-            try:
-                old.close()
-            except OSError:
-                pass
+        _sever(old)
 
     def stop(self) -> None:
         self._stop_evt.set()
@@ -569,6 +611,7 @@ class TensorQueryClient(Element):
 
     def do_chain(self, pad: Pad, buf: Buffer) -> None:
         seq = self._seq = self._seq + 1
+        self.stats.inc("session_requests")
         with self._conn_lock:
             self._last_caps = pad.caps or self._last_caps
         # the entry holds the BUFFER: it is packed at send time, under
@@ -608,11 +651,7 @@ class TensorQueryClient(Element):
                 # backpressure timeout, NOT a dead connection (it is an
                 # OSError subclass, so re-raise before the handler below
                 # tears down a healthy socket)
-                with self._plock:
-                    try:
-                        self._pending.remove(entry)
-                    except ValueError:
-                        pass
+                self._declare_lost(entry)
                 raise
             except (ConnectionError, OSError) as e:
                 # tear down only the socket the failure happened on; a
@@ -620,16 +659,45 @@ class TensorQueryClient(Element):
                 if sock is not None:
                     self._handle_disconnect(sock)
                 if attempt == 2:
-                    with self._plock:
-                        try:
-                            self._pending.remove(entry)
-                        except ValueError:
-                            pass
+                    self._declare_lost(entry)
                     raise ConnectionError(
                         f"{self.name}: send failed after reconnect: {e}") \
                         from e
                 logger.warning("%s: connection lost, reconnecting (%s)",
                                self.name, e)
+
+    def _declare_lost(self, entry) -> None:
+        """Give up on one pending request and SAY SO: the frame is
+        removed from the replay set and counted in
+        ``session_declared_lost`` (plus a structured bus warning), so
+        the accounting identity still balances — never a silent
+        swallow between error detection and re-dial."""
+        with self._plock:
+            try:
+                self._pending.remove(entry)
+            except ValueError:
+                return  # already settled/declared by another path
+        self.stats.inc("session_declared_lost")
+        self.post_message("warning", frames_lost=1, seq=entry[1],
+                          detail="request abandoned after send/replay "
+                                 "failure")
+
+    def kill_link(self) -> int:
+        """Chaos hook (tensor_fault mode=kill-link): force-close the
+        live server connection mid-stream. The recv loop detects it,
+        reconnects, and replays every unanswered frame."""
+        with self._conn_lock:
+            sock = self._sock
+        if sock is None:
+            return 0
+        _sever(sock)
+        self.stats.inc("link_kills")
+        return 1
+
+    def session_info(self) -> Dict:
+        with self._plock:
+            n = len(self._pending)
+        return {"in_flight": n} if n else {}
 
     def _settle_pending(self, seq) -> None:
         """Mark the request a reply answers as no longer owed. Serving
@@ -650,6 +718,16 @@ class TensorQueryClient(Element):
         try:
             while not self._stop_evt.is_set():
                 kind, meta, payloads = recv_msg(sock, stats=self.stats)
+                if kind == MsgKind.DRAIN:
+                    # the server is draining: it will settle what it
+                    # already admitted and shed the rest. Back off new
+                    # sends via upstream QoS with its retry-after hint.
+                    self.stats.inc("server_drains")
+                    retry_ns = int(
+                        float(meta.get("retry_after_ms", 0.0)) * 1e6)
+                    self.send_upstream_event(QosEvent(
+                        proportion=2.0, period_ns=retry_ns))
+                    continue
                 if kind in (MsgKind.RESULT, MsgKind.SHED):
                     with self._conn_lock:
                         stale = sock is not self._sock
@@ -657,7 +735,9 @@ class TensorQueryClient(Element):
                         # our connection was replaced under us: the replay
                         # on the new connection recomputes this frame, so
                         # forwarding would duplicate it — and releasing
-                        # would inflate the NEW semaphore's permit pool
+                        # would inflate the NEW semaphore's permit pool.
+                        # Counted: this is exactly a session dup-drop.
+                        self.stats.inc("session_dup_drops")
                         continue
                     self._settle_pending(meta.get("seq"))
                     if kind == MsgKind.SHED:
@@ -677,6 +757,7 @@ class TensorQueryClient(Element):
                     # (and drop) this final result downstream
                     self.srcpad.push(wire.unpack_buffer(meta, payloads,
                                                         stats=self.stats))
+                    self.stats.inc("session_delivered")
                     inflight.release()
                 elif kind == MsgKind.EOS:
                     break
@@ -710,6 +791,16 @@ class TensorQueryClient(Element):
             if not inflight.acquire(
                     timeout=max(0.0, deadline - time.monotonic())):
                 break
+        # anything still unanswered will never be: downstream is about
+        # to see EOS. Declare the remainder so the accounting identity
+        # (requests == delivered + shed + declared_lost) closes.
+        with self._plock:
+            leftovers = len(self._pending)
+            self._pending.clear()
+        if leftovers:
+            self.stats.inc("session_declared_lost", leftovers)
+            self.post_message("warning", frames_lost=leftovers,
+                              detail="requests still unanswered at EOS")
         if self._sock is not None:
             try:
                 send_msg(self._sock, MsgKind.EOS, {})

@@ -14,6 +14,12 @@ Modes:
 * ``corrupt``    — invert the first chunk's bytes (shape/dtype intact:
                    caps stay valid, the VALUES are garbage)
 * ``drop``       — swallow the buffer (counted in ``stats['dropped']``)
+* ``kill-link``  — call ``kill_link()`` on the element named by
+                   ``target`` (edgesrc/edgesink, query client,
+                   serversrc, servesrc): force-close its live
+                   socket(s) mid-stream, then pass the buffer through.
+                   The session layer's reconnect + resume must absorb
+                   it with zero loss — that is the chaos assertion.
 
 Firing: ``every=N`` fires on every Nth ``transform`` call (N>0), else
 ``probability=p`` fires per-call from a ``seed``-ed RNG — both replay
@@ -39,7 +45,7 @@ from ..pipeline.registry import register_element
 from ..tensors.buffer import Buffer, Chunk
 from .errors import FaultInjected
 
-_MODES = ("raise", "transient", "delay", "corrupt", "drop")
+_MODES = ("raise", "transient", "delay", "corrupt", "drop", "kill-link")
 
 
 @register_element("tensor_fault")
@@ -49,7 +55,8 @@ class TensorFault(TransformElement):
              "probability": 0.0,  # per-call fire probability when every=0
              "seed": 0,           # RNG seed: schedules replay exactly
              "delay-ms": 10.0,    # sleep length for mode=delay
-             "max-faults": -1}    # total injection cap; -1 = unlimited
+             "max-faults": -1,    # total injection cap; -1 = unlimited
+             "target": ""}        # element whose link mode=kill-link kills
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
@@ -104,5 +111,25 @@ class TensorFault(TransformElement):
         if mode == "drop":
             self.stats.inc("dropped")
             return None
+        if mode == "kill-link":
+            self._kill_target_link(n)
+            return buf
         raise ValueError(f"{self.name}: unknown mode {mode!r} "
                          f"(expected one of {_MODES})")
+
+    def _kill_target_link(self, n: int) -> None:
+        """Sever the target element's live socket(s): the network-
+        partition fault shape the session layer must absorb. The buffer
+        in hand passes through — only the LINK dies, not the stream."""
+        tname = str(self.target)
+        el = (self.pipeline.elements.get(tname)
+              if self.pipeline is not None else None)
+        kill = getattr(el, "kill_link", None)
+        if not callable(kill):
+            raise ValueError(
+                f"{self.name}: mode=kill-link needs target= naming an "
+                f"element with a kill_link() hook (got {tname!r})")
+        killed = kill()
+        self.post_message("warning", fault=n, target=tname,
+                          links_killed=killed,
+                          detail="injected link kill")

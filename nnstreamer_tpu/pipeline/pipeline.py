@@ -172,6 +172,33 @@ class Pipeline:
         self.running = False
         return self
 
+    def drain(self, deadline: float = 10.0) -> bool:
+        """Graceful teardown (vs ``stop()``'s hard cut): ask every
+        element to stop admitting new work, flush everything already in
+        flight through queues and the serve batcher behind the EOS
+        barrier, settle pending client correlations, then stop. Returns
+        True when EOS reached every sink inside ``deadline`` seconds —
+        False means the flush timed out and stop() cut it short.
+
+        Safe to call twice; a drain of a never-started pipeline just
+        stops it."""
+        t0 = time.monotonic()
+        self.post_message("drain", deadline=deadline)
+        for e in self.elements.values():
+            try:
+                e.drain()
+            except Exception:  # noqa: BLE001 — drain is best-effort per element
+                logger.warning("%s: drain hook failed", e.name,
+                               exc_info=True)
+        ok = False
+        try:
+            remaining = max(0.0, deadline - (time.monotonic() - t0))
+            ok = bool(self._eos_evt.wait(remaining)) \
+                and self._error is None
+        finally:
+            self.stop()
+        return ok
+
     def wait_eos(self, timeout: Optional[float] = None) -> bool:
         """Block until all sinks saw EOS or an error was posted.
         Returns True on clean EOS; raises on pipeline error."""

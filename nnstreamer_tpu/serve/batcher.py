@@ -87,6 +87,7 @@ class BucketBatcher:
         self._cond = threading.Condition()
         self._fifo: Deque[Request] = deque()
         self._per_stream: Dict[Any, int] = {}
+        self._draining = False
         self.stats = Counters(submitted=0, batches=0, shed_admission=0,
                               shed_deadline=0, cancelled=0)
 
@@ -97,6 +98,11 @@ class BucketBatcher:
         retry-after). The shed callback is NOT invoked here so the caller
         can decide how to answer."""
         with self._cond:
+            if self._draining:
+                # admission is closed: everything already queued will
+                # flush, but new work is shed (retry elsewhere/later)
+                self.stats.inc("shed_admission")
+                return False
             n = self._per_stream.get(req.stream_id, 0)
             if n >= self.max_queue:
                 self.stats.inc("shed_admission")
@@ -106,6 +112,20 @@ class BucketBatcher:
             self.stats.inc("submitted")
             self._cond.notify_all()
         return True
+
+    def drain(self) -> None:
+        """Enter drain: stop admitting, flush what is queued. From here
+        :meth:`submit` sheds everything, partial batches flush without
+        waiting out max-wait, and :meth:`next_batch` returns None once
+        the FIFO is empty — the consumer's EOS barrier."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+
+    @property
+    def draining(self) -> bool:
+        with self._cond:
+            return self._draining
 
     def cancel_stream(self, stream_id: Any) -> int:
         """Reclaim every queued slot of a dead stream (client disconnect
@@ -147,12 +167,15 @@ class BucketBatcher:
                     now = time.monotonic()
                     self._shed_expired_locked(now, shed)
                     if not self._fifo:
+                        if self._draining:
+                            return None  # drained dry: the EOS barrier
                         self._cond.wait(timeout=poll_s)
                         continue
                     head = self._fifo[0]
                     run = self._stackable_run(self.buckets[-1])
                     flush_at = head.t_arrival + self.max_wait_s
-                    if run >= self.buckets[-1] or now >= flush_at:
+                    if run >= self.buckets[-1] or now >= flush_at \
+                            or self._draining:
                         batch = [self._fifo.popleft() for _ in range(run)]
                         for r in batch:
                             n = self._per_stream.get(r.stream_id, 1) - 1

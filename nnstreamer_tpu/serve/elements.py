@@ -22,7 +22,7 @@ import threading
 from typing import Dict, Optional, Tuple
 
 from ..edge import wire
-from ..edge.protocol import MsgKind, recv_msg, send_msg
+from ..edge.protocol import MsgKind, recv_msg, send_msg, sever_socket as _sever
 from ..pipeline.element import SinkElement, SrcElement
 from ..pipeline.pad import Pad
 from ..pipeline.registry import register_element
@@ -242,6 +242,47 @@ class TensorServeSrc(SrcElement):
             if n:
                 logger.info("%s: client %s died, reclaimed %d queued "
                             "slot(s)", self.name, cid, n)
+
+    # -- graceful teardown / chaos hooks -----------------------------------
+    def drain(self) -> None:
+        """Graceful teardown: close scheduler admission (late frames
+        shed with retry-after), tell every client DRAIN so it stops
+        sending and settles, and flush everything already admitted
+        through the batcher -> filter -> sink demux behind the EOS
+        barrier (next_batch returns None once the queue is dry). Every
+        pending correlation is answered — RESULT or SHED — before the
+        pipeline closes."""
+        super().drain()
+        if self.scheduler is not None:
+            self.scheduler.drain()
+        with self._clock:
+            entries = list(self._conns.items())
+        for cid, (conn, lock, _cfg) in entries:
+            try:
+                with lock:
+                    send_msg(conn, MsgKind.DRAIN,
+                             {"client_id": cid,
+                              "retry_after_ms": float(self.retry_after_ms)})
+            except (ConnectionError, OSError):
+                pass
+
+    def drain_flushed(self) -> bool:
+        # the streaming loop may only stop once everything admitted has
+        # been batched out (create()'s next_batch -> None is the same
+        # barrier; this keeps the loop-head check honest)
+        return self.scheduler is None or self.scheduler.pending() == 0
+
+    def kill_link(self) -> int:
+        """Chaos hook (tensor_fault mode=kill-link): force-close every
+        live client connection mid-stream, exactly like the server side
+        of a network partition. Clients reconnect and replay their
+        pending correlations."""
+        with self._clock:
+            victims = list(self._conns.values())
+        for conn, _lock, _cfg in victims:
+            _sever(conn)
+        self.stats.inc("link_kills", len(victims))
+        return len(victims)
 
     # -- the src loop ------------------------------------------------------
     def create(self) -> Optional[Buffer]:

@@ -91,6 +91,23 @@ class ServeScheduler:
     def cancel_stream(self, stream_id: Any) -> int:
         return self.batcher.cancel_stream(stream_id)
 
+    def drain(self) -> None:
+        """Graceful teardown: close admission (late submits shed with
+        retry-after), flush every queued request through the invoke
+        path, and let :meth:`next_batch` return None once the queue is
+        dry — the serving loop's EOS barrier. Pending correlations
+        settle through :meth:`complete` as usual."""
+        self.batcher.drain()
+
+    @property
+    def draining(self) -> bool:
+        return self.batcher.draining
+
+    def pending(self) -> int:
+        """Requests admitted but not yet batched (the drain barrier
+        watches this reach zero)."""
+        return self.batcher.depth()
+
     # -- the batch side ----------------------------------------------------
     def next_batch(self, stop: Optional[threading.Event] = None):
         """Block for the next batch; returns (requests, bucket, stacked

@@ -58,6 +58,33 @@ def _wire_summary(st: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
+def _session_summary(st: Dict[str, Any], el=None) -> Dict[str, Any]:
+    """Condense an element's session_* counters (edge/session.py) into
+    the per-link delivery-guarantee block: sent/delivered, replays,
+    dup-drops, DECLARED losses, ack traffic, heartbeat RTT. {} for
+    sessionless elements so existing reports are unchanged. The numbers
+    are exact by construction — the chaos harness asserts
+    sent == delivered + declared_lost (+ in-flight) from this block."""
+    out: Dict[str, Any] = {}
+    for key, val in st.items():
+        if key.startswith("session_") and val:
+            out[key[8:]] = val
+    pongs = st.get("session_pongs", 0)
+    if pongs:
+        out["rtt_us_avg"] = round(
+            st.get("session_rtt_ns", 0) / pongs / 1e3, 1)
+        out.pop("rtt_ns", None)
+    # live (non-counter) gauges: ring fill, attached sessions, frames
+    # awaiting a correlated result — whatever the element exposes
+    info = getattr(el, "session_info", None)
+    if callable(info):
+        try:
+            out.update(info() or {})
+        except Exception:  # noqa: BLE001 — reporting must never raise
+            pass
+    return out
+
+
 class Reservoir:
     """Algorithm-R bounded reservoir: O(1) cost per observation, fixed
     memory, uniformly representative of the whole stream — the classic
@@ -194,6 +221,9 @@ class Tracer:
                 w = _wire_summary(st)
                 if w:
                     entry["wire"] = w
+                s = _session_summary(st, el)
+                if s:
+                    entry["session"] = s
                 q = getattr(el, "_q", None)
                 if q is not None and hasattr(q, "qsize"):
                     entry["queue_level"] = q.qsize()

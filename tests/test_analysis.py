@@ -294,6 +294,44 @@ class TestRules:
             [("e", Severity.WARNING)]
         assert "age flush" in got[0].message
 
+    def test_session_ring_smaller_than_batch_is_error(self):
+        # CAPS_F32 frames are 3*4*4 floats = 192 B; 8 coalesced = 1536 B,
+        # which a 1 KB ring can never replay: first gap declares loss
+        bad = (  # pipelint: skip — replay ring < one coalesced batch
+            f"tensortestsrc caps={CAPS_F32} ! "
+            "edgesink name=e session=true session-ring-kb=1 "
+            "coalesce-frames=8 coalesce-ms=5")
+        got = findings_for(bad, "session-replay-budget")
+        assert [(f.element, f.pad, f.severity) for f in got] == \
+            [("e", "sink", Severity.ERROR)]
+        assert "GUARANTEED" in got[0].message
+        assert "1536" in got[0].message  # names the provable batch size
+
+    def test_session_ring_budget_adequate_is_clean(self):
+        ok = (f"tensortestsrc caps={CAPS_F32} ! "
+              "edgesink name=e session=true session-ring-kb=64 "
+              "coalesce-frames=8 coalesce-ms=5")
+        assert findings_for(ok, "session-replay-budget") == []
+
+    def test_tiny_ring_without_session_is_clean(self):
+        # session off (it defaults on), no replay promise: budget moot
+        ok = (f"tensortestsrc caps={CAPS_F32} ! "
+              "edgesink name=e session=false session-ring-kb=1 "
+              "coalesce-frames=8 coalesce-ms=5")
+        assert findings_for(ok, "session-replay-budget") == []
+
+    def test_session_without_reconnect_warns(self):
+        bad = (  # pipelint: skip — session acks with no replay path
+            "edgesrc name=s session=true reconnect=false ! fakesink")
+        got = findings_for(bad, "session-no-reconnect")
+        assert [(f.element, f.severity) for f in got] == \
+            [("s", Severity.WARNING)]
+        assert "RESUME" in got[0].message
+
+    def test_session_with_reconnect_is_clean(self):
+        ok = "edgesrc name=s session=true reconnect=true ! fakesink"
+        assert findings_for(ok, "session-no-reconnect") == []
+
     def test_wire_config_valid_specs_are_clean(self):
         desc = (f"tensortestsrc caps={CAPS_U8} ! "
                 "edgesink name=e wire-codec=shuffle-zlib "

@@ -706,3 +706,176 @@ class TestRuntimeLockValidator:
         assert missed == set(), f"static graph missed edges: {missed}"
         # breaker transitions bump their counters under the breaker lock
         assert ("CircuitBreaker._lock", "Counters._lock") in mon.edge_set()
+
+
+# --------------------------------------- zero-loss session chaos
+
+class TestZeroLossChaos:
+    """Acceptance (ISSUE 7): seeded link kills injected mid-stream into a
+    live session link — including mid-DATA_BATCH — must end with zero
+    lost frames and exact sent/delivered/replayed/dup-dropped accounting,
+    no pipeline aborts. Ring eviction must surface as *declared* loss
+    with an exact count, never a silent hole."""
+
+    def _pump(self, pub, sub, n, out="out", per_frame_s=0.01, deadline_s=30):
+        for i in range(n):
+            pub["in"].push_buffer(Buffer.from_arrays(
+                [np.full(4, float(i), np.float32)]))
+            time.sleep(per_frame_s)
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline and len(sub[out].buffers) < n:
+            time.sleep(0.05)
+        return [float(b.chunks[0].host()[0]) for b in sub[out].buffers]
+
+    def test_subscriber_link_kills_zero_loss(self):
+        """≥3 kills injected on the SUBSCRIBER side while the publisher
+        coalesces frames into DATA_BATCH messages — so kills land with
+        partially-consumed batches in flight. Every frame must still
+        arrive exactly once, in order."""
+        port = _free_port()
+        pub = parse_launch(
+            f'appsrc name=in caps="{SERVE_CAPS}" '
+            f'! edgesink name=p port={port} topic=t session=true '
+            'coalesce-frames=4 coalesce-ms=10')
+        pub.start()
+        time.sleep(0.2)
+        sub = parse_launch(
+            f'edgesrc name=s dest-port={port} topic=t session=true '
+            'ack-every=4 timeout=15 '
+            '! tensor_fault name=f mode=kill-link target=s every=10 seed=3 '
+            '! appsink name=out')
+        tracer = sub.enable_tracing()
+        sub.start()
+        time.sleep(0.3)
+        n = 50
+        vals = self._pump(pub, sub, n)
+        kills = sub["f"].stats["faults"]
+        ps = pub["p"].stats.snapshot()
+        ss = sub["s"].stats.snapshot()
+        rep = tracer.report(sub)
+        pub_err, sub_err = pub._error, sub._error
+        pub["in"].end_stream()
+        pub.wait_eos(timeout=10)
+        pub.stop()
+        sub.stop()
+        assert pub_err is None and sub_err is None  # no aborts
+        assert kills >= 3  # the schedule actually fired
+        assert ss["link_kills"] == kills
+        assert vals == [float(i) for i in range(n)]  # zero loss, in order
+        # exact accounting across the whole run: everything the
+        # publisher stamped is delivered (nothing declared lost), and
+        # replays are visible on the sender while every duplicate the
+        # replays produced is counted — not silently absorbed
+        assert ps["session_sent"] == n
+        assert ss["session_delivered"] == n
+        assert ss["session_declared_lost"] == 0
+        assert ps["session_declared_lost"] == 0
+        assert ps["session_resumes"] == kills
+        assert ss["reconnects"] == kills
+        assert ps["session_replayed"] >= ss["session_dup_drops"]
+        # the accounting is surfaced in the trace session block too
+        sess_rep = rep["s"]["session"]
+        assert sess_rep["delivered"] == n
+        assert sess_rep["last_delivered"] == n
+
+    def test_publisher_peer_kills_zero_loss(self):
+        """≥3 kills injected on the PUBLISHER side (the peer-kill arm:
+        the subscriber finds out only when its socket dies). Resume +
+        replay must still deliver every frame exactly once."""
+        port = _free_port()
+        pub = parse_launch(
+            f'appsrc name=in caps="{SERVE_CAPS}" '
+            '! tensor_fault name=f mode=kill-link target=p every=12 seed=5 '
+            f'! edgesink name=p port={port} topic=t session=true')
+        pub.start()
+        time.sleep(0.2)
+        sub = parse_launch(
+            f'edgesrc name=s dest-port={port} topic=t session=true '
+            'ack-every=4 timeout=15 ! appsink name=out')
+        sub.start()
+        time.sleep(0.3)
+        n = 44
+        vals = self._pump(pub, sub, n)
+        kills = pub["f"].stats["faults"]
+        ps = pub["p"].stats.snapshot()
+        ss = sub["s"].stats.snapshot()
+        pub_err, sub_err = pub._error, sub._error
+        pub["in"].end_stream()
+        pub.wait_eos(timeout=10)
+        pub.stop()
+        sub.stop()
+        assert pub_err is None and sub_err is None
+        assert kills >= 3
+        assert vals == [float(i) for i in range(n)]
+        assert ps["session_sent"] == n
+        assert ss["session_delivered"] == n
+        assert ss["session_declared_lost"] == 0
+        assert ss["reconnects"] == kills
+        assert ps["session_resumes"] == kills
+
+    def test_ring_eviction_is_declared_exactly(self):
+        """An outage longer than the replay budget: the gap frames the
+        ring evicted are DECLARED — counted identically on both ends and
+        posted to the bus — and appsink receives exactly the rest. The
+        accounting identity sent == delivered + declared_lost holds."""
+        port = _free_port()
+        pub = parse_launch(
+            f'appsrc name=in caps="{SERVE_CAPS}" '
+            f'! edgesink name=p port={port} topic=t session=true '
+            'session-ring-kb=1')
+        pub.start()
+        time.sleep(0.2)
+        sub1 = parse_launch(
+            f'edgesrc name=s dest-port={port} topic=t session=true '
+            'ack-every=1000 ack-ms=60000 timeout=15 ! appsink name=out')
+        sub1.start()
+        time.sleep(0.3)
+        sid = sub1["s"]._sid
+        # deliver a few frames, then the subscriber vanishes entirely
+        got1 = self._pump(pub, sub1, 5, deadline_s=10)
+        assert len(got1) == 5
+        sub1.stop()
+        time.sleep(0.2)
+        # the outage: far more unacked bytes than the 1 KB ring holds
+        n_gap = 120
+        for i in range(5, 5 + n_gap):
+            pub["in"].push_buffer(Buffer.from_arrays(
+                [np.full(4, float(i), np.float32)]))
+        time.sleep(0.4)
+        # resume under the SAME session id from a fresh pipeline
+        sub2 = parse_launch(
+            f'edgesrc name=s dest-port={port} topic=t session=true '
+            'ack-every=4 timeout=15 ! appsink name=out')
+        sub2["s"]._sid = sid
+        sub2.start()
+        # sub2 resumes from seq 0 (fresh local watermark), so ITS gap is
+        # the full publisher history: 5 early frames + the outage burst
+        total = 5 + n_gap
+        deadline = time.monotonic() + 20
+        ps = pub["p"].stats
+        while time.monotonic() < deadline:
+            ss = sub2["s"].stats
+            if ss["session_delivered"] + ss["session_declared_lost"] \
+                    >= total:
+                break
+            time.sleep(0.05)
+        ss = sub2["s"].stats.snapshot()
+        lost = ss["session_declared_lost"]
+        delivered2 = len(sub2["out"].buffers)
+        msgs = [m for m in sub2.bus.drain() if m.kind == "warning"
+                and "frames_lost" in m.data]
+        pub["in"].end_stream()
+        pub.stop()
+        sub2.stop()
+        assert lost > 0  # the ring really was too small
+        # exactness on both ends: the publisher declared the SAME count,
+        # and the replayed tail is everything-minus-lost, no hole beyond
+        assert ps["session_declared_lost"] == lost
+        assert ss["session_delivered"] == total - lost
+        assert delivered2 == total - lost
+        # the bus carries the declaration with the exact count
+        assert msgs and msgs[0].data["frames_lost"] == lost
+        # and the oldest frames are the evicted ones: the survivors are
+        # the exact contiguous tail (frame value i rode seq i+1)
+        tail = [float(b.chunks[0].host()[0]) for b in sub2["out"].buffers]
+        assert tail == [float(i) for i in range(lost, total)]

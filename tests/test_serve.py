@@ -435,6 +435,82 @@ class TestServeElements:
             f"jit cache must stay within buckets, saw {n_sigs} signatures"
 
 
+# ------------------------------------------- tentpole: graceful drain
+
+class TestDrainSettlement:
+    def test_drain_settles_pending_correlations(self):
+        """Pipeline.drain() on the serving side answers every admitted
+        request — RESULT or SHED, never silence — before close: the
+        client's correlation table empties, the accounting balances
+        exactly, and the scheduler queue is dry."""
+        port = _free_port()
+        server = parse_launch(
+            f'tensor_serve_src name=src port={port} id=44 buckets=1,2,4 '
+            'max-wait-ms=2 retry-after-ms=10 '
+            '! tensor_filter framework=custom-easy model=serve_slow '
+            '! tensor_serve_sink id=44')
+        server.start()
+        time.sleep(0.2)
+        client = parse_launch(
+            f'appsrc name=in caps="{CAPS4}" '
+            f'! tensor_query_client name=qc port={port} timeout=15 '
+            'max-request=32 ! appsink name=out')
+        client.start()
+        sent = 12
+        for i in range(sent):
+            client["in"].push_buffer(Buffer.from_arrays(
+                [np.full(4, float(i), np.float32)]))
+        # let some requests genuinely be in flight before pulling the plug
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            with client["qc"]._plock:
+                if client["qc"]._pending:
+                    break
+            time.sleep(0.005)
+        ok = server.drain(deadline=30)
+        # every correlation must have settled BEFORE the server closed:
+        # no waiting on reconnect/replay here, just reading what arrived
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            with client["qc"]._plock:
+                pending = len(client["qc"]._pending)
+            if (len(client["out"].buffers)
+                    + client["qc"].stats["shed"] >= sent and not pending):
+                break
+            time.sleep(0.02)
+        n_result = len(client["out"].buffers)
+        n_shed = client["qc"].stats["shed"]
+        with client["qc"]._plock:
+            pending = len(client["qc"]._pending)
+        rep = server["src"].scheduler.report()
+        client["in"].end_stream()
+        client.stop()
+        assert ok is True, "drain must flush inside the deadline"
+        assert pending == 0, "drain left correlations unsettled"
+        assert n_result + n_shed == sent  # RESULT xor SHED, nothing lost
+        assert n_result > 0, "everything shed: nothing was in flight"
+        assert server["src"].scheduler.pending() == 0
+        assert rep["completed"] == n_result
+        vals = [float(b.chunks[0].host()[0]) for b in client["out"].buffers]
+        assert vals == sorted(vals)  # per-stream order survives the drain
+        assert set(vals) <= {float(i) for i in range(sent)}  # serve_slow: id
+
+    def test_drain_idle_pipeline_is_clean(self):
+        """Draining a serving pipeline with nothing in flight reaches
+        EOS promptly and twice in a row is safe."""
+        port = _free_port()
+        server = parse_launch(
+            f'tensor_serve_src name=src port={port} id=45 buckets=1 '
+            'max-wait-ms=1 '
+            '! tensor_filter framework=custom-easy model=serve_double '
+            '! tensor_serve_sink id=45')
+        server.start()
+        time.sleep(0.1)
+        assert server.drain(deadline=10) is True
+        assert server.drain(deadline=1) is True  # idempotent
+        assert server["src"].scheduler.pending() == 0
+
+
 # ------------------------------------------------------ satellite: watchdog
 
 class TestWatchdog:
@@ -476,6 +552,47 @@ class TestWatchdog:
         wd.destroy()
         time.sleep(0.25)
         assert not fired.is_set()
+
+    def test_quiesce_suppresses_fire_resume_rearms_fresh(self):
+        """A deliberate stall (drain flush) must not read as a hang:
+        quiesce() holds the dog past its deadline, and resume() grants
+        a fresh full timeout instead of firing retroactively."""
+        fires = []
+        wd = Watchdog(0.1, lambda: fires.append(time.monotonic()))
+        try:
+            wd.feed()
+            wd.quiesce()
+            time.sleep(0.3)          # deadline lapses while quiesced
+            assert fires == []       # the drain never looked like a stall
+            t0 = time.monotonic()
+            wd.resume()
+            time.sleep(0.04)
+            assert fires == []       # fresh timeout, not a retroactive bite
+            deadline = time.monotonic() + 5
+            while not fires and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert len(fires) == 1 and fires[0] - t0 >= 0.1
+        finally:
+            wd.destroy()
+
+    def test_quiesce_nests(self):
+        """Overlapping drains stack: the dog only wakes when every
+        quiesce has been balanced by a resume."""
+        fired = threading.Event()
+        wd = Watchdog(0.08, fired.set)
+        try:
+            wd.feed()
+            wd.quiesce()
+            wd.quiesce()
+            wd.resume()
+            assert wd.quiesced       # one resume is not enough
+            time.sleep(0.2)
+            assert not fired.is_set()
+            wd.resume()
+            assert not wd.quiesced
+            assert fired.wait(2.0)   # now the lapsed-deadline clock runs
+        finally:
+            wd.destroy()
 
 
 # --------------------------------------------- satellite: trace percentiles

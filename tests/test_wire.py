@@ -560,3 +560,346 @@ class TestCoalescing:
         sub.stop()
         pub.stop()
         assert len(sub["out"].buffers) == 3
+
+
+# -- session layer: negotiation, ring, receiver, handshake --------------------
+
+
+from nnstreamer_tpu.edge import session as sess
+
+
+class TestSessionNegotiation:
+    def test_v1_peer_means_no_session(self):
+        assert sess.negotiate(None) is None
+        assert sess.negotiate({}) is None
+        assert sess.negotiate({"v": 0, "sid": "x"}) is None
+        assert sess.negotiate({"v": 1}) is None  # no sid
+        assert sess.accept(None) is None
+        assert sess.accept({}) is None
+
+    def test_round_trip_adopts_cadence_and_budget(self):
+        sid = sess.new_session_id()
+        adv = sess.advertise(sid, ack_every=4, ack_ms=25.0)
+        cfg = sess.negotiate(adv, ring_bytes=1 << 20)
+        assert cfg is not None and cfg.sid == sid
+        assert cfg.ack_every == 4 and cfg.ack_ms == 25.0
+        assert cfg.ring_bytes == 1 << 20
+        echoed = sess.accept(cfg.to_meta())
+        assert echoed.sid == sid and echoed.ack_every == 4
+        assert echoed.ring_bytes == 1 << 20
+
+    def test_session_ids_are_unique(self):
+        assert len({sess.new_session_id() for _ in range(64)}) == 64
+
+
+class TestReplayRing:
+    def _frame(self, nbytes=256):
+        return np.zeros(nbytes, np.uint8)
+
+    def test_replay_covers_retained_gap_exactly(self):
+        ring = sess.ReplayRing(1 << 20)
+        for s in range(1, 11):
+            ring.append(s, self._frame())
+        replay, lost = ring.replay_from(4)
+        assert lost == 0
+        assert [s for s, _ in replay] == list(range(4, 11))
+
+    def test_release_moves_floor_without_declaring_loss(self):
+        ring = sess.ReplayRing(1 << 20)
+        for s in range(1, 11):
+            ring.append(s, self._frame())
+        ring.release(6)
+        assert len(ring) == 4
+        # released frames were ACKed: a resume from above the floor
+        # replays cleanly with zero declared loss
+        replay, lost = ring.replay_from(7)
+        assert lost == 0 and [s for s, _ in replay] == [7, 8, 9, 10]
+
+    def test_eviction_is_declared_exactly(self):
+        ring = sess.ReplayRing(1024)  # room for ~4 x 256B frames
+        for s in range(1, 11):
+            ring.append(s, self._frame(256))
+        assert ring.nbytes <= 1024
+        evicted = ring.evicted_through
+        assert evicted >= 6  # budget forced evictions
+        replay, lost = ring.replay_from(1)
+        # the declared loss is EXACTLY the evicted prefix, and the
+        # replay hands back every single retained frame after it
+        assert lost == evicted
+        assert [s for s, _ in replay] == list(range(evicted + 1, 11))
+
+    def test_newest_frame_survives_even_alone_over_budget(self):
+        ring = sess.ReplayRing(10)
+        ring.append(1, self._frame(256))
+        ring.append(2, self._frame(256))
+        replay, lost = ring.replay_from(1)
+        assert [s for s, _ in replay] == [2] and lost == 1
+
+
+class TestSessionReceiver:
+    def _cfg(self, **kw):
+        return sess.SessionConfig(sess.new_session_id(), **kw)
+
+    def test_dedup_by_watermark(self):
+        r = sess.SessionReceiver(self._cfg())
+        assert r.admit(1) and r.admit(2) and r.admit(3)
+        assert not r.admit(2)  # replayed frame we already have
+        assert not r.admit(3)
+        assert r.dup_drops == 2
+        assert r.admit(4)
+        assert r.last_delivered == 4
+
+    def test_no_seq_always_passes(self):
+        r = sess.SessionReceiver(self._cfg())
+        assert r.admit(None) and r.admit(None)
+        assert r.last_delivered == 0
+
+    def test_ack_due_by_count(self):
+        r = sess.SessionReceiver(self._cfg(ack_every=3, ack_ms=1e9))
+        r.admit(1), r.admit(2)
+        assert r.ack_due(now=r._ack_t) is None
+        r.admit(3)
+        assert r.ack_due(now=r._ack_t) == 3
+        r.mark_acked(3)
+        assert r.ack_due(now=r._ack_t) is None
+
+    def test_ack_due_by_silence(self):
+        r = sess.SessionReceiver(self._cfg(ack_every=100, ack_ms=50.0))
+        r.admit(1)
+        assert r.ack_due(now=r._ack_t + 0.01) is None
+        assert r.ack_due(now=r._ack_t + 0.06) == 1
+
+    def test_reset_adopts_new_seq_space(self):
+        r = sess.SessionReceiver(self._cfg())
+        r.admit(5)
+        r.reset(100)
+        assert not r.admit(99)   # pre-reset seqs are stale
+        assert r.admit(101)
+
+
+class TestHeartbeat:
+    def test_ping_cadence_and_peer_death(self):
+        hb = sess.Heartbeat(1.0, miss_limit=2)
+        t0 = hb.last_sent
+        assert not hb.due(now=t0 + 0.5)
+        assert hb.due(now=t0 + 1.1)
+        hb.sent(now=t0 + 1.1)
+        assert not hb.peer_dead
+        hb.sent(now=t0 + 2.2)
+        assert hb.peer_dead  # two unanswered pings
+
+    def test_pong_and_any_traffic_prove_liveness(self):
+        hb = sess.Heartbeat(1.0, miss_limit=2)
+        t0 = hb.last_sent
+        hb.sent(now=t0 + 1.0)
+        rtt = hb.pong(t0 + 1.0, now=t0 + 1.25)
+        assert abs(rtt - 0.25) < 1e-9
+        assert hb.outstanding == 0 and hb.pongs == 1
+        hb.sent(), hb.heard()  # data counts as a heartbeat
+        assert hb.outstanding == 0
+
+
+# -- session handshake over a raw socket --------------------------------------
+
+
+def _session_subscribe(port, sid, topic="t", last=0, ack_every=4, v2=False):
+    """Raw-socket session subscriber handshake; returns (sock, resume_ack)."""
+    sub = socket.create_connection(("localhost", port), timeout=10)
+    meta = {"topic": topic, "session": sess.advertise(sid, ack_every)}
+    if v2:
+        meta["wire"] = wire.advertise()  # batches only flow on v2 links
+    send_msg(sub, MsgKind.SUBSCRIBE, meta)
+    kind, meta, _ = recv_msg(sub)
+    assert kind == MsgKind.CAPS_ACK
+    assert meta["session"]["sid"] == sid  # the echo adopts OUR sid
+    send_msg(sub, MsgKind.RESUME, {"sid": sid, "last": last})
+    kind, rack, _ = recv_msg(sub)
+    assert kind == MsgKind.RESUME_ACK
+    sub.settimeout(10)
+    return sub, rack
+
+
+class TestSessionHandshake:
+    def test_fresh_attach_then_seq_stamped_frames(self):
+        port = _free_port()
+        pub = parse_launch(f'appsrc name=in caps="{CAPS}" '
+                           f'! edgesink name=p port={port} topic=t')
+        pub.start()
+        time.sleep(0.2)
+        sid = sess.new_session_id()
+        sub, rack = _session_subscribe(port, sid)
+        try:
+            assert rack["resumed"] is False and rack["lost"] == 0
+            for i in range(3):
+                pub["in"].push_buffer(Buffer.from_arrays(
+                    [np.full(4, float(i), np.float32)]))
+            seqs = []
+            while len(seqs) < 3:
+                kind, meta, payloads = recv_msg(sub)
+                assert kind == MsgKind.DATA
+                seqs.append(meta["seq"])
+            base = rack["base"]
+            assert seqs == [base + 1, base + 2, base + 3]
+        finally:
+            sub.close()
+            pub["in"].end_stream()
+            pub.stop()
+
+    def test_v1_subscriber_sees_no_session_echo(self):
+        port = _free_port()
+        pub = parse_launch(f'appsrc name=in caps="{CAPS}" '
+                           f'! edgesink port={port} topic=t session=true')
+        pub.start()
+        time.sleep(0.2)
+        sub = socket.create_connection(("localhost", port), timeout=10)
+        try:
+            send_msg(sub, MsgKind.SUBSCRIBE, {"topic": "t"})
+            kind, meta, _ = recv_msg(sub)
+            assert kind == MsgKind.CAPS_ACK
+            assert "session" not in meta  # strict v1 on this link
+            pub["in"].push_buffer(Buffer.from_arrays(
+                [np.zeros(4, np.float32)]))
+            sub.settimeout(10)
+            kind, meta, _ = recv_msg(sub)
+            assert kind == MsgKind.DATA and "seq" not in meta
+        finally:
+            sub.close()
+            pub["in"].end_stream()
+            pub.stop()
+
+    def test_resume_replays_exactly_the_gap(self):
+        port = _free_port()
+        pub = parse_launch(f'appsrc name=in caps="{CAPS}" '
+                           f'! edgesink name=p port={port} topic=t')
+        pub.start()
+        time.sleep(0.2)
+        sid = sess.new_session_id()
+        sub, rack = _session_subscribe(port, sid)
+        base = rack["base"]
+        for i in range(4):
+            pub["in"].push_buffer(Buffer.from_arrays(
+                [np.full(4, float(i), np.float32)]))
+        got = []
+        while len(got) < 4:
+            kind, meta, _ = recv_msg(sub)
+            assert kind == MsgKind.DATA
+            got.append(meta["seq"])
+        sub.close()  # the outage
+        for i in range(4, 8):  # published while we were gone
+            pub["in"].push_buffer(Buffer.from_arrays(
+                [np.full(4, float(i), np.float32)]))
+        # wait until every outage frame is stamped into the replay ring:
+        # resuming earlier would see a shorter gap and live tail frames
+        deadline = time.monotonic() + 10.0
+        while pub["p"].stats["session_sent"] < 8:
+            assert time.monotonic() < deadline, "outage frames never sent"
+            time.sleep(0.02)
+        sub, rack = _session_subscribe(port, sid, last=base + 4)
+        try:
+            assert rack["resumed"] is True and rack["lost"] == 0
+            replayed = []
+            while len(replayed) < 4:
+                kind, meta, payloads = recv_msg(sub)
+                assert kind == MsgKind.DATA
+                replayed.append((meta["seq"],
+                                 float(wire.unpack_buffer(
+                                     meta, payloads).chunks[0].host()[0])))
+            # exactly the gap, in order, carrying the missed values
+            assert replayed == [(base + 5 + i, float(4 + i))
+                                for i in range(4)]
+            assert pub["p"].stats["session_replayed"] == 4
+            assert pub["p"].stats["session_resumes"] == 1
+        finally:
+            sub.close()
+            pub["in"].end_stream()
+            pub.stop()
+
+    def test_ring_eviction_becomes_declared_loss(self):
+        port = _free_port()
+        # a ring too small for the outage: 1 KB holds very few frames
+        pub = parse_launch(f'appsrc name=in caps="{CAPS}" '
+                           f'! edgesink name=p port={port} topic=t '
+                           'session-ring-kb=1')
+        pub.start()
+        time.sleep(0.2)
+        sid = sess.new_session_id()
+        sub, rack = _session_subscribe(port, sid)
+        base = rack["base"]
+        sub.close()  # vanish immediately: nothing ever ACKed
+        n = 80  # 80 x 16B payloads + overhead >> 1 KB ring
+        for i in range(n):
+            pub["in"].push_buffer(Buffer.from_arrays(
+                [np.full(4, float(i), np.float32)]))
+        deadline = time.monotonic() + 10.0
+        while pub["p"].stats["session_sent"] < n:
+            assert time.monotonic() < deadline, "burst never fully sent"
+            time.sleep(0.02)
+        sub, rack = _session_subscribe(port, sid, last=base)
+        try:
+            assert rack["resumed"] is True
+            lost = rack["lost"]
+            assert lost > 0  # the ring could not cover the gap...
+            replayed = []
+            while len(replayed) < n - lost:
+                kind, meta, _ = recv_msg(sub)
+                assert kind == MsgKind.DATA
+                replayed.append(meta["seq"])
+            # ...and the declared count is EXACT: lost + replayed
+            # partitions the gap with no overlap and no hole
+            assert replayed == list(range(base + lost + 1, base + n + 1))
+            assert pub["p"].stats["session_declared_lost"] == lost
+        finally:
+            sub.close()
+            pub["in"].end_stream()
+            pub.stop()
+
+
+class TestBatchReplayAcrossReconnect:
+    def test_partial_batch_never_half_delivered(self):
+        """Satellite: DATA_BATCH coalescing x reconnect. A subscriber
+        that dies mid-stream under coalescing resumes to EVERY frame
+        after its watermark — frames from partially-delivered batches
+        are fully replayed (or fully declared lost), never half-lost."""
+        port = _free_port()
+        pub = parse_launch(f'appsrc name=in caps="{CAPS}" '
+                           f'! edgesink name=p port={port} topic=t '
+                           'coalesce-frames=4 coalesce-ms=30')
+        pub.start()
+        time.sleep(0.2)
+        sid = sess.new_session_id()
+        sub, rack = _session_subscribe(port, sid, v2=True)
+        base = rack["base"]
+        n = 16
+        for i in range(n):
+            pub["in"].push_buffer(Buffer.from_arrays(
+                [np.full(4, float(i), np.float32)]))
+        # read ONE message — with coalescing this is a 4-frame batch —
+        # then die with the rest of the stream un-consumed
+        kind, meta, payloads = recv_msg(sub)
+        assert kind == MsgKind.DATA_BATCH
+        first = wire.unpack_batch(meta, payloads)
+        watermark = first[-1].extras["seq"]
+        assert watermark == base + len(first)
+        sub.close()
+        time.sleep(0.4)  # let the remaining batches hit the dead sock
+        sub, rack = _session_subscribe(port, sid, last=watermark, v2=True)
+        try:
+            assert rack["resumed"] is True and rack["lost"] == 0
+            seqs = []
+            while len(seqs) < n - len(first):
+                kind, meta, payloads = recv_msg(sub)
+                # replay is per-frame DATA; fresh live traffic may
+                # arrive as DATA_BATCH — both carry seqs
+                if kind == MsgKind.DATA:
+                    seqs.append(meta["seq"])
+                else:
+                    assert kind == MsgKind.DATA_BATCH
+                    seqs.extend(b.extras["seq"]
+                                for b in wire.unpack_batch(meta, payloads))
+            # every frame past the watermark exactly once, in order:
+            # no dup from the partially-read batch, no hole after it
+            assert seqs == list(range(watermark + 1, base + n + 1))
+        finally:
+            sub.close()
+            pub["in"].end_stream()
+            pub.stop()
