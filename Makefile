@@ -10,7 +10,7 @@ LIB := $(BUILD)/libnnstpu.so
 EXAMPLES := $(BUILD)/custom_passthrough.so $(BUILD)/custom_scaler.so
 
 .PHONY: native clean test check tier1 lint racecheck chaos chaos-zeroloss \
-	fuse-parity package
+	chaos-fleet fuse-parity package
 
 native: $(LIB) $(EXAMPLES)
 
@@ -23,6 +23,7 @@ check: native lint racecheck
 	python -c "import nnstreamer_tpu as nt; print('import ok:', len(nt.pipeline.registry.element_names()), 'elements')"
 	$(MAKE) fuse-parity
 	$(MAKE) chaos
+	$(MAKE) chaos-fleet
 
 # `make fuse-parity` = the fusion compiler's byte-parity oracle: every
 # fusible pipeline in the corpus (plus a built-in representative suite)
@@ -43,6 +44,14 @@ chaos:
 chaos-zeroloss:
 	env JAX_PLATFORMS=cpu python -m pytest \
 		tests/test_chaos.py::TestZeroLossChaos -q
+
+# `make chaos-fleet` = the fleet-failover acceptance run (slow-marked,
+# excluded from tier-1): 4 broker-registered replicas behind the router,
+# 8 concurrent client streams, one replica killed mid-run and one
+# administratively drained — every frame must settle RESULT xor SHED
+# with zero declared losses and zero stream aborts.
+chaos-fleet:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_router.py -q -m slow
 
 # `make tier1` = the exact ROADMAP.md tier-1 verify gate, verbatim
 # (timeout, log tee, pass-dot count and all).

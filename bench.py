@@ -788,6 +788,126 @@ def bench_chaos_zeroloss_row(n_frames: int = 60, every: int = 10) -> dict:
     }}
 
 
+def bench_fleet_failover_row(n_replicas: int = 3, n_clients: int = 4,
+                             n_frames: int = 16) -> dict:
+    """Fleet-failover row (ISSUE 8 acceptance): concurrent client
+    streams through the tensor_serve_router while one replica is killed
+    mid-run and another administratively drained. ``verdict`` is
+    "zero-loss" only when every admitted frame settled RESULT xor SHED
+    on both ledgers (client and router), nothing was declared lost, and
+    no stream aborted."""
+    import socket as _socket
+    import threading as _threading
+
+    import numpy as np
+
+    from nnstreamer_tpu import Buffer, parse_launch
+    from nnstreamer_tpu.filters import register_custom_easy
+
+    register_custom_easy("fleet_bench_double", lambda x: x * 2)
+    caps = ("other/tensors,format=static,num_tensors=1,"
+            "types=(string)float32,dimensions=(string)4")
+    reps = []
+    for i in range(n_replicas):
+        sp = parse_launch(
+            f"tensor_serve_src name=src port=0 id={130 + i} buckets=1,2,4 "
+            "max-wait-ms=2 "
+            "! tensor_filter framework=custom-easy model=fleet_bench_double "
+            f"! tensor_serve_sink id={130 + i}")
+        sp.start()
+        reps.append(sp)
+    replica_spec = ",".join(
+        f"localhost:{sp['src'].bound_port}" for sp in reps)
+    rp = parse_launch(
+        f"tensor_serve_router name=rt port=0 replicas={replica_spec} "
+        "heartbeat-ms=50 breaker-reset-ms=300")
+    rp.start()
+    rt = rp["rt"]
+    time.sleep(0.3)
+    barrier = _threading.Barrier(n_clients + 1, timeout=60)
+    results: dict = {}
+    t0 = time.perf_counter()
+
+    def run_client(tag: int) -> None:
+        c = parse_launch(
+            f'appsrc name=in caps="{caps}" '
+            f"! tensor_query_client name=qc port={rt.bound_port} "
+            "timeout=15 max-request=16 ! appsink name=out")
+        c.start()
+        half = n_frames // 2
+
+        def push(lo, hi):
+            for i in range(lo, hi):
+                c["in"].push_buffer(Buffer.from_arrays(
+                    [np.full(4, 100.0 * tag + i, np.float32)]))
+
+        def settled():
+            return len(c["out"].buffers) + c["qc"].stats["shed"]
+
+        push(0, half)
+        deadline = time.monotonic() + 60
+        while settled() < half and time.monotonic() < deadline:
+            time.sleep(0.02)
+        barrier.wait()  # streams live -> inject the faults
+        barrier.wait()  # faults in -> second half
+        push(half, n_frames)
+        deadline = time.monotonic() + 60
+        while settled() < n_frames and time.monotonic() < deadline:
+            time.sleep(0.02)
+        st = c["qc"].stats.snapshot()
+        results[tag] = {
+            "delivered": len(c["out"].buffers), "shed": st["shed"],
+            "declared_lost": st["session_declared_lost"],
+            "aborted": c._error is not None,
+        }
+        c["in"].end_stream()
+        c.stop()
+
+    threads = [_threading.Thread(target=run_client, args=(t,))
+               for t in range(n_clients)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    loads = [sp["src"].scheduler.report()["completed"] for sp in reps]
+    victim = loads.index(max(loads))
+    reps[victim].stop()  # process death
+    loads[victim] = -1
+    drained = loads.index(max(loads))
+    rt.drain_replica(f"localhost:{reps[drained]['src'].bound_port}")
+    time.sleep(0.3)
+    barrier.wait()
+    for t in threads:
+        t.join(timeout=120)
+    wall = time.perf_counter() - t0
+    st = rt.stats.snapshot()
+    rp.stop()
+    for i, sp in enumerate(reps):
+        if i != victim:
+            sp.stop()
+    sent = n_clients * n_frames
+    client_ok = (len(results) == n_clients and not any(
+        r["aborted"] or r["declared_lost"]
+        or r["delivered"] + r["shed"] != n_frames
+        for r in results.values()))
+    zero_loss = (client_ok
+                 and st["router_requests"] == sent
+                 and st["router_requests"] == st["router_delivered"]
+                 + st["router_shed"] + st["router_orphaned"]
+                 and st["router_orphaned"] == 0)
+    return {"fleet_failover": {
+        "replicas": n_replicas,
+        "clients": n_clients,
+        "frames": sent,
+        "fps_under_chaos": round(sent / wall, 1) if wall else None,
+        "delivered": int(st["router_delivered"]),
+        "shed": int(st["router_shed"]),
+        "redispatched": int(st["router_redispatched"]),
+        "dup_drops": int(st["router_dup_drops"]),
+        "replica_deaths": int(st["router_replica_deaths"]),
+        "verdict": "zero-loss" if zero_loss else "LOST-FRAMES",
+    }}
+
+
 # -- device-resident invoke rows (measured-FLOP MFU) --------------------------
 
 def _compiled_flops(jf, *args) -> float:
@@ -1199,6 +1319,15 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001
         print(f"# chaos zero-loss row failed: {e}", file=sys.stderr)
         extras["chaos_zeroloss"] = None
+
+    # fleet row: multi-replica serving through the router under a
+    # mid-run replica kill + drain (ISSUE 8). Self-adjudicating like
+    # the chaos row: the verdict comes from its own exact ledgers.
+    try:
+        extras.update(bench_fleet_failover_row())
+    except Exception as e:  # noqa: BLE001
+        print(f"# fleet failover row failed: {e}", file=sys.stderr)
+        extras["fleet_failover"] = None
 
     # separate traced pass: tracer bookkeeping must not sit inside the
     # timed region of the fps row above. Long enough (120 frames vs ~40

@@ -595,12 +595,56 @@ class SessionNoReconnectRule(Rule):
                     "reconnect or drop the session overhead", e.name)
 
 
+class RouterNoReplicasRule(Rule):
+    """A fleet router with neither a static replica list nor a broker
+    topic can never route anything: every request it accepts sheds.
+    That is a dead configuration, not a tuning choice — an error before
+    launch."""
+
+    id = "router-no-replicas"
+    severity = Severity.ERROR
+
+    def check(self, ctx: LintContext):
+        for e in ctx.of_kind("tensor_serve_router"):
+            replicas = str(getattr(e, "replicas", "") or "").strip()
+            topic = str(getattr(e, "topic", "") or "").strip()
+            if not replicas and not topic:
+                yield self.finding(
+                    "router has zero replica endpoints and no broker "
+                    "topic: every request will be shed; set replicas= "
+                    "(host:port,...) or topic= + dest-port= for broker "
+                    "discovery", e.name)
+
+
+class RouterAffinitySessionlessRule(Rule):
+    """affinity=true keys dispatch on per-client session identity — but
+    session=false disables minting those keys, so every frame silently
+    degrades to least-loaded placement and the operator's affinity
+    expectation (stream order, warm per-replica state) is not actually
+    being honored."""
+
+    id = "router-affinity-sessionless"
+    severity = Severity.WARNING
+
+    def check(self, ctx: LintContext):
+        for e in ctx.of_kind("tensor_serve_router"):
+            if bool(getattr(e, "affinity", True)) \
+                    and not bool(getattr(e, "session", True)):
+                yield self.finding(
+                    "affinity=true with session=false: no session keys "
+                    "are minted, so dispatch silently degrades to "
+                    "least-loaded and sessions do NOT stick to a "
+                    "replica; enable session or set affinity=false",
+                    e.name)
+
+
 ALL_RULES: List[Rule] = [
     DanglingPadRule(), CycleRule(), TeeNoQueueRule(), JitSignatureRule(),
     ShardingRule(), SinklessBranchRule(), CombinerDtypeRule(),
     UnboundedAdmissionRule(), LinkResilienceRule(), ErrorPolicyRule(),
     WireConfigRule(), FusionBreakRule(), FusionTransferRule(),
     SessionReplayBudgetRule(), SessionNoReconnectRule(),
+    RouterNoReplicasRule(), RouterAffinitySessionlessRule(),
 ]
 
 

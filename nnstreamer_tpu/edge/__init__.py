@@ -5,7 +5,7 @@ tensor_query_* and edgesrc/edgesink in the reference (SURVEY.md §2.4).
 Here the control+data plane is a length-prefixed TCP protocol (DCN-side);
 in-pod scale-out instead uses jax.sharding over ICI (parallel/).
 """
-from .broker import DiscoveryBroker, discover
+from .broker import DiscoveryBroker, discover, discover_meta
 from .mqtt import MqttBroker
 from .protocol import MsgKind, recv_msg, send_msg
 from .session import (Heartbeat, ReplayRing, SessionConfig, SessionReceiver,
@@ -13,6 +13,7 @@ from .session import (Heartbeat, ReplayRing, SessionConfig, SessionReceiver,
 from .wire import WireConfig, accept, advertise, negotiate, tune_socket
 
 __all__ = ["MsgKind", "send_msg", "recv_msg", "DiscoveryBroker", "discover",
+           "discover_meta",
            "MqttBroker", "WireConfig", "advertise", "negotiate", "accept",
            "tune_socket", "SessionConfig", "SessionReceiver", "ReplayRing",
            "Heartbeat", "new_session_id"]

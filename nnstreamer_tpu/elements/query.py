@@ -458,7 +458,7 @@ class TensorQueryClient(Element):
         #                       + session_declared_lost + in-flight
         # always balances — a frame that dies between socket-error
         # detection and re-dial is DECLARED, never silently swallowed
-        self.stats.update({"reconnects": 0, "shed": 0,
+        self.stats.update({"reconnects": 0, "shed": 0, "link_errors": 0,
                            "session_requests": 0, "session_delivered": 0,
                            "session_replayed": 0, "session_dup_drops": 0,
                            "session_declared_lost": 0})
@@ -468,7 +468,12 @@ class TensorQueryClient(Element):
         return {"src": None}
 
     def _endpoints(self, timeout: float) -> list:
-        """Candidate servers, most preferred first."""
+        """Candidate servers, most preferred first. An EMPTY broker
+        answer raises ConnectionError so :meth:`_connect`'s Backoff loop
+        re-queries (with ``link_errors`` accounting) until a server
+        registers or the timeout budget runs out — a momentarily-bare
+        topic (fleet rolling, server restarting) must not fail the
+        stream fast."""
         if self.connect_type.upper() == "HYBRID":
             from ..edge.broker import discover
             eps = discover(self.dest_host or self.host,
@@ -516,7 +521,11 @@ class TensorQueryClient(Element):
                         if self._try_endpoint(host, port, remaining):
                             return
                 except (ConnectionError, OSError) as e:
+                    # every failed round — unreachable broker, empty
+                    # endpoint list, refused dial — is a counted link
+                    # error, then the Backoff ladder re-queries
                     last_err = e
+                    self.stats.inc("link_errors")
                 # racecheck: ok(deliberate: reconnects are serialized under _connect_mutex, the sleep is stop-interruptible and deadline-budgeted)
                 backoff.sleep(self._stop_evt)
             raise ConnectionError(
@@ -763,6 +772,7 @@ class TensorQueryClient(Element):
                     break
         except (ConnectionError, OSError):
             if not self._stop_evt.is_set():
+                self.stats.inc("link_errors")
                 logger.warning("%s: server connection closed", self.name)
                 # unblock senders so the next frame triggers a reconnect
                 self._handle_disconnect(sock)

@@ -14,7 +14,8 @@ replies (retry-after backpressure) when admission or deadlines drop a
 request.
 """
 from .batcher import BucketBatcher, Request, stack_requests
+from .router import FleetRouter, HashRing, parse_replicas
 from .scheduler import SERVE_TABLE, ServeScheduler
 
 __all__ = ["BucketBatcher", "Request", "ServeScheduler", "SERVE_TABLE",
-           "stack_requests"]
+           "stack_requests", "FleetRouter", "HashRing", "parse_replicas"]

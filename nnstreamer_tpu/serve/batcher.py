@@ -144,6 +144,14 @@ class BucketBatcher:
                 return len(self._fifo)
             return self._per_stream.get(stream_id, 0)
 
+    def occupancy(self) -> Dict[str, int]:
+        """One consistent (depth, active streams) snapshot — the
+        batcher's half of the fleet router's load report (two depth()
+        calls could tear across a batch pop)."""
+        with self._cond:
+            return {"depth": len(self._fifo),
+                    "streams": len(self._per_stream)}
+
     # -- the consumer ------------------------------------------------------
     def bucket_for(self, n: int) -> int:
         """Smallest bucket >= n (the largest bucket caps a run)."""

@@ -48,6 +48,11 @@ class TensorServeSrc(SrcElement):
     """
 
     PROPS = {"host": "localhost", "port": 3001, "id": 0, "timeout": 10.0,
+             # HYBRID: advertise (topic -> host:port) on the discovery
+             # broker at dest-host:dest-port, with occupancy metadata so
+             # a fleet router can seed its least-loaded dispatch
+             "connect-type": "TCP", "topic": "",
+             "dest-host": "localhost", "dest-port": 0,
              # bucketed batch sizes, ascending; one jit signature each
              "buckets": "1,2,4,8",
              # a partial batch flushes when its oldest request has
@@ -75,6 +80,7 @@ class TensorServeSrc(SrcElement):
                                      Optional[wire.WireConfig]]] = {}
         self._clock = threading.Lock()
         self.scheduler: Optional[ServeScheduler] = None
+        self._broker_sock: Optional[socket.socket] = None
         self.stats["link_errors"] = 0
 
     @property
@@ -106,11 +112,46 @@ class TensorServeSrc(SrcElement):
             target=self._accept_loop, name=f"serve-accept:{self.name}",
             daemon=True)
         self._accept_thread.start()
+        if str(self.connect_type).upper() == "HYBRID":
+            # hold the registration connection open for our lifetime
+            # (the broker drops the advertisement the moment it closes);
+            # the metadata seeds a fleet router's least-loaded dispatch
+            try:
+                self._broker_sock = socket.create_connection(
+                    (self.dest_host or "localhost", int(self.dest_port)),
+                    timeout=self.timeout)
+                send_msg(self._broker_sock, MsgKind.REGISTER,
+                         {"topic": self.topic, "host": self.host,
+                          "port": self.bound_port,
+                          "meta": dict(self.scheduler.occupancy(),
+                                       role="serve")})
+            except OSError:
+                # don't leak a half-started server: closing the listener
+                # also terminates the accept thread
+                if self._broker_sock is not None:
+                    try:
+                        self._broker_sock.close()
+                    except OSError:
+                        pass
+                    self._broker_sock = None
+                try:
+                    self._listener.close()
+                except OSError:
+                    pass
+                self._listener = None
+                unregister_scheduler(self.id)
+                raise
         super().start()
 
     def stop(self) -> None:
         super().stop()
         unregister_scheduler(self.id)
+        if self._broker_sock is not None:
+            try:
+                self._broker_sock.close()
+            except OSError:
+                pass
+            self._broker_sock = None
         if self._listener is not None:
             try:
                 self._listener.close()
@@ -172,6 +213,16 @@ class TensorServeSrc(SrcElement):
                     for b in wire.unpack_batch(meta, payloads,
                                                stats=self.stats):
                         self._admit_buf(cid, b, b.extras.get("seq"))
+                elif kind == MsgKind.PING:
+                    # heartbeat reply doubles as a load report: the
+                    # fleet router's least-loaded tiebreak reads the
+                    # occupancy snapshot it carries (uses the per-conn
+                    # send lock — a PONG must not interleave with a
+                    # RESULT the sink thread is writing)
+                    self._send(cid, MsgKind.PONG,
+                               {"t": meta.get("t"),
+                                "load": self.scheduler.occupancy()
+                                if self.scheduler is not None else {}})
                 elif kind == MsgKind.EOS:
                     break
         except (ConnectionError, OSError, ValueError) as exc:

@@ -157,6 +157,21 @@ class ServeScheduler:
             self.stats.inc("completed", len(batch))
 
     # -- metrics -----------------------------------------------------------
+    def occupancy(self) -> Dict[str, Any]:
+        """O(1) load snapshot for fleet routing: queue depth + active
+        streams (batcher), rolling bucket occupancy, and the queue-delay
+        p50. Cheap enough to piggyback on every PONG heartbeat reply
+        and on the broker REGISTER advertisement."""
+        b = self.batcher.occupancy()
+        with self._mlock:
+            s = self.stats.snapshot()
+            qd = self._queue_delay.percentiles()
+        filled = s["bucket_rows"] - s["rows_padded"]
+        return {"depth": b["depth"], "streams": b["streams"],
+                "occupancy_avg": round(filled / s["bucket_rows"], 4)
+                if s["bucket_rows"] else 0.0,
+                "queue_delay_us_p50": round(qd["p50"] / 1e3, 1)}
+
     def report(self) -> Dict[str, Any]:
         """Occupancy, queue delay and batch latency percentiles, shed
         counts — the per-batch observability the ISSUE's serving stack

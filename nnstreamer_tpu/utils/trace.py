@@ -227,9 +227,24 @@ class Tracer:
                 q = getattr(el, "_q", None)
                 if q is not None and hasattr(q, "qsize"):
                     entry["queue_level"] = q.qsize()
+            for name, el in pipeline.elements.items():
+                rep = getattr(el, "router_report", None)
+                if callable(rep):
+                    r = rep()
+                    if r:
+                        out.setdefault(name, {})["router"] = r
             fusion = self._fusion_block(pipeline, out)
             if fusion:
                 out["fusion"] = fusion
+        # control-plane counters: any live in-process discovery broker
+        # (register/query/error totals) surfaces next to the elements
+        try:
+            from ..edge.broker import live_broker_stats
+            b = live_broker_stats()
+            if b:
+                out["broker"] = b
+        except Exception:  # noqa: BLE001 — observability must not raise
+            pass
         return out
 
     @staticmethod

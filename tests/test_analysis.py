@@ -338,6 +338,42 @@ class TestRules:
                 "coalesce-frames=8 coalesce-ms=5")
         assert findings_for(desc, "wire-config") == []
 
+    def test_router_without_membership_is_error(self):
+        bad = (  # pipelint: skip — router with nothing to route to
+            "tensor_serve_router name=rt port=0")
+        got = findings_for(bad, "router-no-replicas")
+        assert [(f.element, f.severity) for f in got] == \
+            [("rt", Severity.ERROR)]
+        assert "shed" in got[0].message
+
+    def test_router_with_static_replicas_is_clean(self):
+        ok = "tensor_serve_router name=rt port=0 replicas=localhost:3001"
+        assert findings_for(ok, "router-no-replicas") == []
+
+    def test_router_with_broker_topic_is_clean(self):
+        ok = ("tensor_serve_router name=rt port=0 "
+              "topic=fleet dest-port=3100")
+        assert findings_for(ok, "router-no-replicas") == []
+
+    def test_router_affinity_without_session_warns(self):
+        bad = (  # pipelint: skip — affinity keys need the session layer
+            "tensor_serve_router name=rt port=0 "
+            "replicas=localhost:3001 affinity=true session=false")
+        got = findings_for(bad, "router-affinity-sessionless")
+        assert [(f.element, f.severity) for f in got] == \
+            [("rt", Severity.WARNING)]
+        assert "least-loaded" in got[0].message
+
+    def test_router_affinity_with_session_is_clean(self):
+        ok = ("tensor_serve_router name=rt port=0 "
+              "replicas=localhost:3001 affinity=true session=true")
+        assert findings_for(ok, "router-affinity-sessionless") == []
+
+    def test_router_no_affinity_sessionless_is_clean(self):
+        ok = ("tensor_serve_router name=rt port=0 "
+              "replicas=localhost:3001 affinity=false session=false")
+        assert findings_for(ok, "router-affinity-sessionless") == []
+
 
 CLEAN_CORPUS = [
     # straight filter chain on fixed caps
@@ -360,6 +396,8 @@ CLEAN_CORPUS = [
     # demux fan-out with per-branch queues
     f"tensortestsrc caps={CAPS_U8} ! tensor_demux name=d tensorpick=0 "
     "d.src_0 ! queue ! appsink name=out",
+    # fleet router fronting a static replica list
+    "tensor_serve_router port=0 replicas=localhost:3001,localhost:3002",
 ]
 
 
