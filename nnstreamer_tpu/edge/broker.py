@@ -83,15 +83,23 @@ class DiscoveryBroker:
         _LIVE.discard(self)
         self._listener.stop()
 
-    def endpoints(self, topic: str) -> List[Tuple[str, int]]:
+    def entries(self, topic: str) -> List[Tuple[Tuple[str, int], Dict]]:
+        """Pruned, CONSISTENT snapshot: [((host, port), meta), ...]
+        taken under one lock acquisition. The QUERY_ACK derives both
+        parallel lists from this, so a REGISTER / disconnect cleanup /
+        concurrent prune landing between two separate reads can never
+        misalign an endpoint with another replica's metadata."""
         self._prune_dead(topic)
         with self._lock:
-            return [ep for ep, _, _ in self._topics.get(topic, [])]
+            return [(ep, dict(info))
+                    for ep, _, info in self._topics.get(topic, [])]
+
+    def endpoints(self, topic: str) -> List[Tuple[str, int]]:
+        return [ep for ep, _ in self.entries(topic)]
 
     def endpoints_meta(self, topic: str) -> List[Dict]:
         """Registration metadata, parallel to :meth:`endpoints`."""
-        with self._lock:
-            return [dict(info) for _, _, info in self._topics.get(topic, [])]
+        return [info for _, info in self.entries(topic)]
 
     # -- internals ----------------------------------------------------------
     def _prune_dead(self, topic: str) -> None:
@@ -140,10 +148,10 @@ class DiscoveryBroker:
                                 ep, topic)
                 elif kind == MsgKind.QUERY:
                     self.stats.inc("broker_queries")
-                    topic = meta["topic"]
+                    snap = self.entries(meta["topic"])
                     send_msg(conn, MsgKind.QUERY_ACK,
-                             {"endpoints": self.endpoints(topic),
-                              "endpoints_meta": self.endpoints_meta(topic)})
+                             {"endpoints": [ep for ep, _ in snap],
+                              "endpoints_meta": [info for _, info in snap]})
                 else:
                     break
         except ValueError:

@@ -245,18 +245,11 @@ class EdgeSink(SinkElement):
                     state["acked"] = max(state["acked"], last)
                     state["resumes"] += 1
                     replay, lost = self._ring.replay_from(last + 1)
-            with sub.lock:
-                send_msg(conn, MsgKind.RESUME_ACK,
-                         {"sid": scfg.sid, "resumed": resumed,
-                          "lost": lost, "base": base}, stats=self.stats)
-                for seq, frame in replay:
-                    meta, payloads = wire.pack_buffer(frame, cfg,
-                                                      stats=self.stats)
-                    meta["seq"] = seq
-                    if self.topic:
-                        meta["topic"] = self.topic
-                    send_msg(conn, MsgKind.DATA, meta, payloads,
-                             stats=self.stats)
+            # count BEFORE anything reaches the wire: the subscriber
+            # learns the loss from the RESUME_ACK, so any observer it
+            # tips off must already see the counters updated — never a
+            # window where the peer knows about declared loss that the
+            # publisher's own stats have not recorded yet
             if replay:
                 self.stats.inc("session_replayed", len(replay))
             if lost:
@@ -269,6 +262,18 @@ class EdgeSink(SinkElement):
                     detail="replay ring evicted part of the resume gap")
             if resumed:
                 self.stats.inc("session_resumes")
+            with sub.lock:
+                send_msg(conn, MsgKind.RESUME_ACK,
+                         {"sid": scfg.sid, "resumed": resumed,
+                          "lost": lost, "base": base}, stats=self.stats)
+                for seq, frame in replay:
+                    meta, payloads = wire.pack_buffer(frame, cfg,
+                                                      stats=self.stats)
+                    meta["seq"] = seq
+                    if self.topic:
+                        meta["topic"] = self.topic
+                    send_msg(conn, MsgKind.DATA, meta, payloads,
+                             stats=self.stats)
             with self._subs_lock:
                 self._subs.append(sub)
         threading.Thread(target=self._sub_reader, args=(sub,),

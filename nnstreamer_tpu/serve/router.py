@@ -46,6 +46,7 @@ import hashlib
 import socket
 import threading
 import time
+from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 from ..edge import wire
@@ -177,7 +178,8 @@ class FleetRouter:
         self.stats.update({
             "router_requests": 0, "router_delivered": 0, "router_shed": 0,
             "router_redispatched": 0, "router_dup_drops": 0,
-            "router_orphaned": 0, "router_replica_deaths": 0,
+            "router_orphaned": 0, "router_orphan_drops": 0,
+            "router_replica_deaths": 0,
             "router_replica_connects": 0, "router_replica_drains": 0,
             "link_errors": 0})
         self._listener = TcpListener(host, port, self._client_conn,
@@ -197,6 +199,11 @@ class FleetRouter:
         # rseq -> [cid, client seq, buffer, replica key, attempts]: every
         # dispatched-but-unsettled request; the failover unit
         self._pending: Dict[int, list] = {}
+        # rseqs retired by _drop_client (their client died first): a late
+        # replica answer for one is an orphan answer, not a failover
+        # duplicate — the two causes are counted apart. Bounded FIFO;
+        # guarded by _plock like the pending table it shadows.
+        self._orphan_rseqs: "OrderedDict[int, bool]" = OrderedDict()
         self._rseq = 0
         self._plock = threading.Lock()
         self._maint_thread: Optional[threading.Thread] = None
@@ -321,6 +328,9 @@ class FleetRouter:
             orphans = [r for r, e in self._pending.items() if e[0] == cid]
             for r in orphans:
                 del self._pending[r]
+                self._orphan_rseqs[r] = True
+            while len(self._orphan_rseqs) > 4096:
+                self._orphan_rseqs.popitem(last=False)
         if orphans:
             self.stats.inc("router_orphaned", len(orphans))
 
@@ -380,12 +390,19 @@ class FleetRouter:
                 return
             except (ConnectionError, OSError):
                 # the pending entry is reclaimed BEFORE the down-handler
-                # runs so the failover sweep cannot double-dispatch it
+                # runs so the failover sweep cannot double-dispatch it;
+                # a miss means a concurrent _replica_down (which severed
+                # this socket, making our send raise) swept the entry
+                # first and already re-dispatched it — that path owns
+                # the retry, looping here would mint a second pending
+                # entry (duplicate settles) for one client request
                 with self._plock:
-                    self._pending.pop(rseq, None)
+                    owned = self._pending.pop(rseq, None) is not None
+                self._replica_down(key, sock)
+                if not owned:
+                    return
                 tried.add(key)
                 attempts += 1
-                self._replica_down(key, sock)
 
     def _pick(self, skey: Optional[str], exclude: set
               ) -> Optional[Tuple[str, socket.socket, threading.Lock,
@@ -423,13 +440,19 @@ class FleetRouter:
                            "retry_after_ms": float(self.retry_after_ms)})
 
     def _settle(self, rseq) -> Optional[list]:
-        """Pop one pending entry exactly once; None = already settled
-        (a duplicate answer after failover re-dispatch — dropped and
-        counted, never forwarded twice)."""
+        """Pop one pending entry exactly once; None = already settled.
+        A miss is classified before counting: an answer owed to a
+        client that disconnected first (entry retired by _drop_client)
+        is ``router_orphan_drops``; anything else is a duplicate after
+        failover re-dispatch, ``router_dup_drops``. Either way it is
+        dropped and counted, never forwarded twice."""
         with self._plock:
             ent = self._pending.pop(rseq, None)
+            orphan = (ent is None
+                      and self._orphan_rseqs.pop(rseq, False))
         if ent is None:
-            self.stats.inc("router_dup_drops")
+            self.stats.inc("router_orphan_drops" if orphan
+                           else "router_dup_drops")
         return ent
 
     # -- replica side ------------------------------------------------------
@@ -451,7 +474,15 @@ class FleetRouter:
             if kind != MsgKind.CAPS_ACK:
                 raise ConnectionError(f"bad handshake {kind}")
             cfg = wire.accept(meta.get("wire"))
-            sock.settimeout(None)
+            # keep the per-op timeout for the link's lifetime: a wedged
+            # replica whose TCP send buffer fills must make the blocked
+            # send (PING under the send lock, or a dispatch) raise into
+            # _replica_down, not hold the fleet-wide maintenance thread
+            # hostage. Recv timeouts never fire on a healthy link —
+            # PONGs arrive every heartbeat_s << timeout — so hitting
+            # one means the heartbeat machinery itself is wedged and
+            # declaring the link dead is the right backstop.
+            sock.settimeout(self.timeout)
         except (ConnectionError, OSError, ValueError):
             try:
                 sock.close()

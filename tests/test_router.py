@@ -159,6 +159,12 @@ class TestRouterE2E:
                 assert r["breaker"] == "closed"
                 assert r["pongs"] >= 1
                 assert "depth" in r["load"]
+            # replica links keep a bounded per-op timeout: a wedged
+            # replica whose TCP buffer fills must raise into
+            # _replica_down, never block the fleet-wide maintenance
+            # thread's PING under the send lock forever
+            for rob in rt.router._replicas.values():
+                assert rob.sock.gettimeout() == rt.router.timeout
         finally:
             c["in"].end_stream()
             c.stop()
@@ -491,6 +497,121 @@ class TestBrokerFleet:
             if sp is not None:
                 sp.stop()
             broker.stop()
+
+    def test_query_ack_snapshot_stays_aligned_under_churn(self):
+        """The QUERY_ACK's endpoints / endpoints_meta lists come from ONE
+        consistent snapshot: a REGISTER or disconnect cleanup landing
+        mid-answer must never zip one replica's occupancy metadata onto
+        a different endpoint."""
+        from nnstreamer_tpu.edge.protocol import MsgKind, send_msg
+        broker = DiscoveryBroker(port=0)
+        broker.start()
+        regs = []
+        try:
+            for i in range(2):  # two stable, distinguishable registrations
+                s = socket.create_connection(("localhost",
+                                              broker.bound_port))
+                send_msg(s, MsgKind.REGISTER,
+                         {"topic": "flt-e", "host": f"h{i}",
+                          "port": 1000 + i, "meta": {"ident": i}})
+                regs.append(s)
+            time.sleep(0.1)
+            stop = threading.Event()
+
+            def churn():  # a third member flapping register/death
+                while not stop.is_set():
+                    s = socket.create_connection(("localhost",
+                                                  broker.bound_port))
+                    send_msg(s, MsgKind.REGISTER,
+                             {"topic": "flt-e", "host": "hx", "port": 9999,
+                              "meta": {"ident": "x"}})
+                    s.close()
+            t = threading.Thread(target=churn, daemon=True)
+            t.start()
+            try:
+                valid = {("h0", 1000): 0, ("h1", 1001): 1, ("hx", 9999): "x"}
+                for _ in range(50):
+                    for ep, info in discover_meta(
+                            "localhost", broker.bound_port, "flt-e"):
+                        # every endpoint rides with ITS OWN metadata
+                        assert info.get("ident") == valid[ep]
+            finally:
+                stop.set()
+                t.join(timeout=5)
+        finally:
+            for s in regs:
+                s.close()
+            broker.stop()
+
+
+# ------------------------------------------------- failover race regressions
+
+class TestFailoverRaces:
+    """Unit-level pins for the dispatch/failover/settle races: a never-
+    started FleetRouter (no listener, no threads) driven directly."""
+
+    def _bare_router(self):
+        from nnstreamer_tpu.serve.router import FleetRouter
+        return FleetRouter(port=0)
+
+    def test_send_failure_pop_miss_cedes_retry_to_sweep(self):
+        """Double-dispatch race: the dispatcher's send fails BECAUSE a
+        concurrent _replica_down severed the socket — and that path's
+        failover sweep already reclaimed and re-dispatched the pending
+        entry. The sender's exception path must read the pop miss as
+        'someone else owns the retry' and stop, not dispatch the same
+        request again under a fresh rseq."""
+        r = self._bare_router()
+        buf = Buffer.from_arrays([np.zeros(4, np.float32)])
+
+        class _RacedSock:
+            def sendmsg(self, *a, **k):
+                # the sweep wins the race at the worst moment: the entry
+                # is gone (and re-homed) by the time this send raises
+                with r._plock:
+                    r._pending.clear()
+                raise BrokenPipeError("severed by _replica_down")
+
+            def sendall(self, *a, **k):
+                self.sendmsg()
+
+        picks = []
+
+        def fake_pick(skey, exclude):
+            picks.append(set(exclude))
+            # a buggy retry loop would come back for a second pick
+            return (("r:1", _RacedSock(), threading.Lock(), None)
+                    if len(picks) == 1 else None)
+
+        r._pick = fake_pick
+        r._dispatch(0, buf, 1, None)
+        st = r.stats.snapshot()
+        assert len(picks) == 1  # no second dispatch attempt
+        assert st["router_requests"] == 1
+        assert st["router_shed"] == 0  # the sweep owns the settle now
+        assert r.pending() == 0
+
+    def test_late_answer_for_dead_client_is_orphan_not_dup(self):
+        """_settle classifies a miss: an answer owed to a client that
+        disconnected first is an orphan answer, not a failover
+        duplicate — client churn must not inflate router_dup_drops."""
+        r = self._bare_router()
+        buf = Buffer.from_arrays([np.zeros(4, np.float32)])
+        with r._plock:
+            r._rseq += 1
+            rseq = r._rseq
+            r._pending[rseq] = [7, 1, buf, "r:1", 0]
+        r._drop_client(7)
+        assert r.stats.snapshot()["router_orphaned"] == 1
+        assert r._settle(rseq) is None  # the replica answers late
+        st = r.stats.snapshot()
+        assert st["router_orphan_drops"] == 1
+        assert st["router_dup_drops"] == 0
+        # a miss with no orphan record IS a failover duplicate
+        assert r._settle(999) is None
+        st = r.stats.snapshot()
+        assert st["router_dup_drops"] == 1
+        assert st["router_orphan_drops"] == 1
 
 
 # ------------------------------------------------------- chaos acceptance
