@@ -10,7 +10,7 @@ LIB := $(BUILD)/libnnstpu.so
 EXAMPLES := $(BUILD)/custom_passthrough.so $(BUILD)/custom_scaler.so
 
 .PHONY: native clean test check tier1 lint racecheck chaos chaos-zeroloss \
-	chaos-fleet fuse-parity package
+	chaos-fleet fuse-parity async-parity package
 
 native: $(LIB) $(EXAMPLES)
 
@@ -22,6 +22,7 @@ check: native lint racecheck
 	python -m pytest tests/ -q -m 'not slow'
 	python -c "import nnstreamer_tpu as nt; print('import ok:', len(nt.pipeline.registry.element_names()), 'elements')"
 	$(MAKE) fuse-parity
+	$(MAKE) async-parity
 	$(MAKE) chaos
 	$(MAKE) chaos-fleet
 
@@ -31,6 +32,13 @@ check: native lint racecheck
 # (tools/fuse_parity.py exits nonzero on any divergence).
 fuse-parity:
 	env JAX_PLATFORMS=cpu python tools/fuse_parity.py
+
+# `make async-parity` = the overlapped executor's byte-parity oracle:
+# the same corpus, each pipeline run unfused with every tensor_filter
+# forced to a 4-frame in-flight window vs in-flight=1 — the window must
+# be invisible in the sink bytes (and in their order).
+async-parity:
+	env JAX_PLATFORMS=cpu python tools/fuse_parity.py --mode async
 
 # `make chaos` = the full fault-injection harness: the slow seeded
 # serve-pipeline schedules (excluded from tier-1 by the slow marker)

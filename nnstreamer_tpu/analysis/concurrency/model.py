@@ -46,6 +46,9 @@ SOURCE = "source-loop"       # supervised src streaming thread
 TIMER = "timer"              # watchdog / breaker half-open timers
 NET = "net-reader"           # accept loops + per-client reader threads
 WORKER = "worker"            # scheduler/batcher flush threads
+DISPATCHER = "dispatcher"    # overlap window: chain-side frame dispatch
+COMPLETER = "completer"      # overlap window: per-element completer
+UPLOADER = "uploader"        # coalescing H2D upload service thread
 INIT = "init"                # quiescent lifecycle (dropped in locksets)
 
 # (ancestor class, method name) -> role: known entry points. Applied to
@@ -60,6 +63,16 @@ DEFAULT_SEEDS: List[Tuple[str, str, str]] = [
     ("Supervisor", "ok", SOURCE),
     ("Watchdog", "_loop", TIMER),
     ("TensorFilter", "_on_idle", TIMER),
+    # async overlapped executor (elements/overlap.py): the chain thread
+    # dispatches into the window, a dedicated thread completes frames
+    ("OverlapExecutor", "submit", DISPATCHER),
+    ("OverlapExecutor", "_complete_loop", COMPLETER),
+    ("TensorFilter", "_complete_frame", COMPLETER),
+    ("TensorFilter", "_complete_error", COMPLETER),
+    ("FusedSegment", "_complete_frame", COMPLETER),
+    ("FusedSegment", "_complete_error", COMPLETER),
+    # bidirectional transfer service (tensors/transfer.py)
+    ("_Uploader", "_run", UPLOADER),
 ]
 
 # methods whose accesses are ordered by the pipeline lifecycle
@@ -564,6 +577,12 @@ def _spawn_role(target: str, model: Model, cls_name: str) -> str:
         return NET
     if any(k in n for k in ("watch", "timer", "idle")):
         return TIMER
+    # before the generic loop/stream bucket: _complete_loop is the
+    # overlap completer, _run on an uploader is the H2D service
+    if "complete" in n:
+        return COMPLETER
+    if "upload" in n:
+        return UPLOADER
     if "loop" in n or "stream" in n:
         if "SrcElement" in model.ancestry(cls_name):
             return SOURCE

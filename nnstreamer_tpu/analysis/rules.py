@@ -638,6 +638,76 @@ class RouterAffinitySessionlessRule(Rule):
                     e.name)
 
 
+class AsyncWindowRule(Rule):
+    """In-flight window sanity for tensor_filter's overlapped executor.
+
+    ERROR on ``in-flight < 1`` (a zero/negative window can never admit
+    a frame: the dispatcher blocks forever on the first buffer) and on
+    a window wider than the serve batcher's jit-signature budget when
+    fed by a bucketed tensor_serve_src — up to K distinct bucket
+    signatures can then be in flight at once, each holding a compiled
+    executable, which blows the same budget JitSignatureRule enforces
+    for compiles. WARN when ``in-flight > 1`` feeds an order-sensitive
+    element (aggregator stacking windows, trainer consuming a sample
+    stream, rate pacing on PTS) with the reorder buffer disabled —
+    completions may then overtake each other on error gaps and the
+    downstream element silently mis-groups frames.
+    """
+
+    id = "async-window"
+    severity = Severity.ERROR
+    _ORDER_SENSITIVE = ("tensor_aggregator", "tensor_trainer",
+                        "tensor_rate")
+
+    def check(self, ctx: LintContext):
+        budget = JitSignatureRule.bucket_budget
+        for filt in ctx.of_kind("tensor_filter"):
+            try:
+                k = int(getattr(filt, "in_flight", 1))
+            except (TypeError, ValueError):
+                yield self.finding(
+                    f"in-flight={getattr(filt, 'in_flight', None)!r} is "
+                    f"not an integer", filt.name)
+                continue
+            if k < 1:
+                yield self.finding(
+                    f"in-flight={k}: the window can never admit a frame "
+                    f"(dispatch blocks forever); use 1 for synchronous "
+                    f"operation", filt.name)
+                continue
+            if k > budget and any(
+                    kind_of(s) == "tensor_serve_src"
+                    and len([b for b in str(s.buckets).split(",") if b]) > 1
+                    for s in ctx.sources_feeding(filt)):
+                yield self.finding(
+                    f"in-flight={k} behind a bucketed tensor_serve_src: "
+                    f"up to {k} distinct bucket signatures can be in "
+                    f"flight at once, exceeding the jit-signature budget "
+                    f"of {budget} live executables; shrink the window or "
+                    f"the bucket list", filt.name)
+            if k > 1 and not bool(getattr(filt, "reorder", True)):
+                hit = self._order_sensitive_downstream(ctx, filt)
+                if hit is not None:
+                    yield self.finding(
+                        f"in-flight={k} with reorder=false feeds "
+                        f"order-sensitive {kind_of(hit)} '{hit.name}': "
+                        f"completions can arrive out of PTS order; "
+                        f"enable reorder or set in-flight=1",
+                        filt.name, severity=Severity.WARNING)
+
+    def _order_sensitive_downstream(self, ctx: LintContext, elem):
+        seen, stack = set(), list(ctx.downstream(elem))
+        while stack:
+            e = stack.pop()
+            if e.name in seen:
+                continue
+            seen.add(e.name)
+            if kind_of(e) in self._ORDER_SENSITIVE:
+                return e
+            stack.extend(ctx.downstream(e))
+        return None
+
+
 ALL_RULES: List[Rule] = [
     DanglingPadRule(), CycleRule(), TeeNoQueueRule(), JitSignatureRule(),
     ShardingRule(), SinklessBranchRule(), CombinerDtypeRule(),
@@ -645,6 +715,7 @@ ALL_RULES: List[Rule] = [
     WireConfigRule(), FusionBreakRule(), FusionTransferRule(),
     SessionReplayBudgetRule(), SessionNoReconnectRule(),
     RouterNoReplicasRule(), RouterAffinitySessionlessRule(),
+    AsyncWindowRule(),
 ]
 
 

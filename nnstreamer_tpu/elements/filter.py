@@ -12,6 +12,7 @@ The hot path is one cached-executable dispatch (SURVEY.md §3.2 analog).
 from __future__ import annotations
 
 import collections
+import threading
 import time
 from typing import Any, List, Optional
 
@@ -23,6 +24,9 @@ from ..filters.registry import (detect_framework, find_filter,
                                 shared_model_get, shared_model_insert,
                                 shared_model_release)
 from ..tensors.buffer import Buffer, Chunk
+# module scope, not per-frame: submit_fetch runs on every prefetch-host
+# frame on the hot path
+from ..tensors.transfer import submit_fetch
 from ..tensors.caps import Caps
 from ..tensors.info import TensorInfo, TensorsConfig, TensorsInfo
 from ..tensors.types import TensorFormat
@@ -94,6 +98,30 @@ class TensorFilter(Element):
         "breaker-threshold": 0,
         "breaker-reset-ms": 1000.0,
         "breaker-retry-after-ms": 50.0,
+        # K-frame in-flight invoke window (elements/overlap.py): keep up
+        # to K frames between dispatch and completion, completing each on
+        # a dedicated completer thread instead of blocking the chain
+        # thread — on a remote-attached chip this hides the link RTT
+        # behind the compute (throughput ≈ min(K/RTT, chip ceiling)
+        # instead of ≈ 1/RTT). 1 = synchronous (default). Requires a
+        # backend with async dispatch (SUPPORTS_DISPATCH, e.g. jax);
+        # otherwise the filter logs a notice and stays synchronous.
+        "in-flight": 1,
+        # restore PTS order before push() when in-flight > 1 (bounded
+        # reorder buffer with a stall deadline). Disable only when every
+        # downstream consumer is order-insensitive — pipelint WARNs if an
+        # aggregator/trainer/rate sits downstream without it.
+        "reorder": True,
+        # how long the reorder buffer dams the pipeline waiting for a
+        # missing frame before abandoning the gap
+        "reorder-deadline-ms": 1000.0,
+        # donate input device buffers to the dispatched executable
+        # (XLA input/output aliasing): the H2D staging buffer is reused
+        # for the outputs, halving HBM traffic per frame. Only honored
+        # on device platforms that support donation (tpu/gpu) and only
+        # for buffers this filter itself uploaded; device-resident
+        # inputs owned by upstream elements are never donated.
+        "donate-input": False,
         # run one zero-filled invoke at caps negotiation so the XLA
         # compile (tens of seconds for a big model) happens before the
         # first real frame instead of stalling it (no reference analog:
@@ -115,6 +143,18 @@ class TensorFilter(Element):
         self._recent_latency = collections.deque(maxlen=_MAX_RECENT)
         self._invoke_count = 0
         self._total_latency_ns = 0
+        # dispatch-to-return timing, distinct from dispatch-to-completion
+        # (_recent_latency): under an in-flight window the former is the
+        # chain-thread cost (near-zero by design), the latter the real
+        # model+link latency. Both are surfaced; QoS uses completion.
+        self._recent_dispatch = collections.deque(maxlen=_MAX_RECENT)
+        self._dispatch_count = 0
+        self._total_dispatch_ns = 0
+        # latency fields are written by the chain thread (sync path) AND
+        # the completer thread (windowed path): one leaf lock covers the
+        # deques/counters (racecheck: rmw from two roles needs it)
+        self._stats_lock = threading.Lock()
+        self._overlap = None               # OverlapExecutor when K > 1
         self._start_time = None
         self._watchdog: Optional[Watchdog] = None
         self._in_combi: Optional[List[int]] = None
@@ -196,6 +236,27 @@ class TensorFilter(Element):
                 name=self.name, on_transition=self._on_breaker_transition)
         else:
             self._breaker = None
+        self._overlap = None
+        window = int(self.in_flight)
+        if window > 1:
+            if self.invoke_async:
+                logger.info("%s: in-flight=%d ignored — invoke-async "
+                            "backends manage their own in-flight frames",
+                            self.name, window)
+            elif not getattr(self.fw, "SUPPORTS_DISPATCH", False):
+                logger.info("%s: in-flight=%d ignored — framework %s has "
+                            "no async dispatch; staying synchronous",
+                            self.name, window, self.fw.NAME)
+            else:
+                from .overlap import OverlapExecutor
+                self._overlap = OverlapExecutor(
+                    window,
+                    complete_cb=self._complete_frame,
+                    error_cb=self._complete_error,
+                    push_cb=self.push,
+                    name=self.name,
+                    reorder=bool(self.reorder),
+                    reorder_deadline_s=float(self.reorder_deadline_ms) / 1e3)
 
     def drain(self) -> None:
         """During a deliberate drain the filter may sit idle for longer
@@ -205,11 +266,19 @@ class TensorFilter(Element):
         stops after the drain, so the quiesce is never resumed: destroy
         in stop() cleans up.)"""
         super().drain()
+        if self._overlap is not None:
+            self._overlap.flush()
         if self._watchdog is not None:
             self._watchdog.quiesce()
 
     def stop(self) -> None:
         super().stop()
+        if self._overlap is not None:
+            # settle every in-flight frame before the framework closes;
+            # the (stopped) executor is kept so post-run trace reports
+            # still see the window/overlap numbers
+            self._overlap.flush()
+            self._overlap.stop()
         if self._watchdog is not None:
             self._watchdog.destroy()
         if self.fw is not None:
@@ -458,6 +527,9 @@ class TensorFilter(Element):
         inputs = [c.raw for c in buf.chunks]
         if self._in_combi:
             inputs = [inputs[i] for i in self._in_combi]
+        if self._overlap is not None:
+            self._dispatch_windowed(buf, inputs)
+            return
         t0 = time.perf_counter_ns()
         try:
             if self.invoke_async:
@@ -467,6 +539,7 @@ class TensorFilter(Element):
                 # fallback for backends that don't thread ctx through
                 self._async_template = buf
                 self.fw.invoke_async(inputs, ctx=buf)
+                self._record_dispatch(time.perf_counter_ns() - t0)
                 self._record_latency(time.perf_counter_ns() - t0)
                 return
             outputs = self.fw.invoke(inputs)
@@ -478,57 +551,138 @@ class TensorFilter(Element):
             self.stats.inc("frames_dropped")
             return
         except Exception as exc:  # noqa: BLE001
-            # invoke failure drops THIS frame but keeps the pipeline alive
-            # (≙ tensor_filter.c:961-963); the error is surfaced on the
-            # bus as a warning with an error counter, not a fatal error.
-            # Warnings are rate-limited (1, 2, 4, 8, ... then every 64th)
-            # so a permanently broken model can't flood an unread bus, and
-            # carry the message string only — holding the exception object
-            # would pin the traceback (and the input tensors) in memory.
-            n = self.stats.inc("invoke_errors")
-            self.stats.inc("frames_dropped")
-            if self._breaker is not None:
-                self._breaker.record_failure()
-            logger.warning("%s: invoke failed (frame dropped, pipeline "
-                           "kept): %s", self.name, exc)
-            if n & (n - 1) == 0 or n % 64 == 0:
-                self.post_message("warning", error=str(exc),
-                                  invoke_errors=n,
-                                  remedy="check the model's input "
-                                         "dims/dtypes against the "
-                                         "negotiated caps, or the "
-                                         "subplugin's own logs")
+            self._account_invoke_error(exc)
             return
         if self._breaker is not None:
             self._breaker.record_success()
-        self._record_latency(time.perf_counter_ns() - t0)
+        # synchronous path: dispatch and completion are the same event
+        dt = time.perf_counter_ns() - t0
+        self._record_dispatch(dt)
+        self._record_latency(dt)
         if self._watchdog is not None:
             self._watchdog.feed()
-        nv = buf.extras.get("batch_valid_rows")
-        if nv is not None and buf.chunks:
-            # micro-batched upstream (e.g. query serversrc batch=K) padded
-            # the stack to a fixed compile signature; drop padded rows of
-            # HOST outputs (a free numpy view). Only outputs whose leading
-            # dim IS the padded batch axis are touched — anything else
-            # (flat vectors, [N,7] detection tables) passes through.
-            # Device outputs ship padded: on the tunneled dev chip every
-            # eager device op is an RPC costing more than the padded D2H
-            # bytes save (measured: ~25% aggregate fan-out fps).
-            pad = buf.chunks[0].shape[0] if buf.chunks[0].shape else None
-            outputs = [o[:nv] if isinstance(o, np.ndarray)
-                       and o.ndim >= 1 and pad is not None
-                       and o.shape[0] == pad and pad > nv else o
-                       for o in outputs]
+        outputs = self._trim_padded_rows(buf, outputs)
         if self.prefetch_host:
             # enqueue on the coalescing fetch service: the frame leaves
             # this element immediately carrying PendingHost handles, and
             # every frame queued while a fetch RPC is in flight shares
             # the next one. (copy_to_host_async does NOT hide the tunnel
             # RTT — measured worse than a plain blocking fetch.)
-            from ..tensors.fetch import submit_fetch
             outputs = submit_fetch(outputs)
         out_chunks = self._combine_outputs(buf, outputs)
         self.push(buf.with_chunks(out_chunks))
+
+    # -- in-flight window (overlapped execution) ---------------------------
+    def _dispatch_windowed(self, buf: Buffer, inputs: List[Any]) -> None:
+        """DISPATCHER side of the overlap split: take a window slot
+        (blocking here IS the backpressure — it propagates into the
+        upstream queue exactly like a slow synchronous invoke), enqueue
+        the device program, and hand completion to the completer
+        thread. The chain thread never waits on the device."""
+        t_disp = self._overlap.window.acquire()
+        t0 = time.perf_counter_ns()
+        try:
+            handle = self.fw.dispatch(inputs,
+                                      donate=bool(self.donate_input))
+        except InvokeDrop:
+            if self._breaker is not None:
+                self._breaker.record_success()
+            self.stats.inc("frames_dropped")
+            self._overlap.window.release(t_disp)
+            return
+        except Exception as exc:  # noqa: BLE001
+            self._account_invoke_error(exc)
+            self._settle_failed_rows(buf)
+            self._overlap.window.release(t_disp)
+            return
+        self._record_dispatch(time.perf_counter_ns() - t0)
+        self._overlap.submit(buf, handle, t_disp)
+
+    def _complete_frame(self, entry) -> Buffer:
+        """COMPLETER side: materialize one frame's results and run the
+        per-frame accounting the sync path does inline. Raises on invoke
+        failure — the executor routes that to :meth:`_complete_error`."""
+        outputs = self.fw.complete(entry.payload)
+        if self._breaker is not None:
+            self._breaker.record_success()
+        self._record_latency(time.perf_counter_ns() - entry.t_dispatch_ns)
+        if self._watchdog is not None:
+            self._watchdog.feed()
+        buf = entry.buf
+        outputs = self._trim_padded_rows(buf, outputs)
+        if self.prefetch_host:
+            outputs = submit_fetch(outputs)
+        return buf.with_chunks(self._combine_outputs(buf, outputs))
+
+    def _complete_error(self, entry, exc: BaseException) -> None:
+        """A frame that failed at completion: same per-frame accounting
+        as a sync invoke failure (invoke_errors / frames_dropped /
+        breaker), even though the chain thread returned long ago."""
+        self._account_invoke_error(exc)
+        self._settle_failed_rows(entry.buf)
+
+    def _settle_failed_rows(self, buf: Buffer) -> None:
+        """Serve-batch rows of a failed frame get their on_shed callback
+        (wire-level SHED + retry-after) instead of silently timing out
+        at the client's deadline. Accounted under frames_dropped — not
+        ``shed``, which counts breaker-open rejections."""
+        rows = buf.extras.get("serve_rows")
+        if not rows:
+            return
+        for req in rows:
+            if req.on_shed is not None:
+                try:
+                    req.on_shed(req)
+                except Exception:  # noqa: BLE001 — one dead client
+                    logger.warning("%s: shed callback failed for "
+                                   "stream %s", self.name,
+                                   req.stream_id, exc_info=True)
+
+    def _account_invoke_error(self, exc: BaseException) -> None:
+        # invoke failure drops THIS frame but keeps the pipeline alive
+        # (≙ tensor_filter.c:961-963); the error is surfaced on the
+        # bus as a warning with an error counter, not a fatal error.
+        # Warnings are rate-limited (1, 2, 4, 8, ... then every 64th)
+        # so a permanently broken model can't flood an unread bus, and
+        # carry the message string only — holding the exception object
+        # would pin the traceback (and the input tensors) in memory.
+        n = self.stats.inc("invoke_errors")
+        self.stats.inc("frames_dropped")
+        if self._breaker is not None:
+            self._breaker.record_failure()
+        logger.warning("%s: invoke failed (frame dropped, pipeline "
+                       "kept): %s", self.name, exc)
+        if n & (n - 1) == 0 or n % 64 == 0:
+            self.post_message("warning", error=str(exc),
+                              invoke_errors=n,
+                              remedy="check the model's input "
+                                     "dims/dtypes against the "
+                                     "negotiated caps, or the "
+                                     "subplugin's own logs")
+
+    @staticmethod
+    def _trim_padded_rows(buf: Buffer, outputs: List[Any]) -> List[Any]:
+        nv = buf.extras.get("batch_valid_rows")
+        if nv is None or not buf.chunks:
+            return outputs
+        # micro-batched upstream (e.g. query serversrc batch=K) padded
+        # the stack to a fixed compile signature; drop padded rows of
+        # HOST outputs (a free numpy view). Only outputs whose leading
+        # dim IS the padded batch axis are touched — anything else
+        # (flat vectors, [N,7] detection tables) passes through.
+        # Device outputs ship padded: on the tunneled dev chip every
+        # eager device op is an RPC costing more than the padded D2H
+        # bytes save (measured: ~25% aggregate fan-out fps).
+        pad = buf.chunks[0].shape[0] if buf.chunks[0].shape else None
+        return [o[:nv] if isinstance(o, np.ndarray)
+                and o.ndim >= 1 and pad is not None
+                and o.shape[0] == pad and pad > nv else o
+                for o in outputs]
+
+    def transfer_report(self) -> dict:
+        """Window occupancy / overlap stats for trace.report()'s
+        ``transfer`` block; {} when running synchronously."""
+        return self._overlap.report() if self._overlap is not None else {}
 
     # -- circuit breaker ---------------------------------------------------
     def _shed_frame(self, buf: Buffer) -> None:
@@ -565,6 +719,11 @@ class TensorFilter(Element):
     # -- QoS throttling ----------------------------------------------------
     def handle_event(self, pad: Pad, event: Event) -> None:
         from ..pipeline.events import FlushEvent, SegmentEvent
+        if self._overlap is not None:
+            # serialized events (EOS, caps, segment) must not overtake
+            # in-flight frames: barrier until the completer has settled
+            # and pushed everything dispatched before this event
+            self._overlap.flush()
         if isinstance(event, (SegmentEvent, FlushEvent)):
             # new segment / flush = PTS discontinuity: stale throttle state
             # would otherwise qos-drop every post-restart frame forever
@@ -583,8 +742,14 @@ class TensorFilter(Element):
     def handle_upstream_event(self, pad: Pad, event: Event) -> None:
         if isinstance(event, QosEvent):
             # keep the larger of the downstream-requested spacing and our
-            # own invoke latency (we can never go faster than the model)
-            lat_ns = int(self.latency_average_us() * 1e3)
+            # own sustainable cadence. Synchronously that cadence is the
+            # invoke latency; under a K-frame window K completions are in
+            # flight at once, so the sustainable period is latency/K —
+            # throttling to full completion latency would forfeit the
+            # overlap the window exists to win.
+            window = self._overlap.window.limit \
+                if self._overlap is not None else 1
+            lat_ns = int(self.latency_average_us() * 1e3) // max(1, window)
             self._throttle_period_ns = max(event.period_ns, lat_ns) \
                 if event.proportion > 1.0 else 0
             if self._throttle_period_ns == 0:
@@ -618,29 +783,57 @@ class TensorFilter(Element):
 
     # -- stats ------------------------------------------------------------
     def _record_latency(self, dt_ns: int) -> None:
-        self._invoke_count += 1
-        self._total_latency_ns += dt_ns
-        self._recent_latency.append(dt_ns)
-        if self.latency:
-            self.latency_us = self.latency_average_us()
-            self._maybe_report_latency(self.latency_us)
+        """Record one frame's dispatch-to-COMPLETION latency. Sync path:
+        chain thread; windowed path: completer thread — every mutation
+        sits under _stats_lock, and the bus post happens outside it
+        (posting is I/O; never under a leaf lock)."""
+        report_us = None
+        with self._stats_lock:
+            self._invoke_count += 1
+            self._total_latency_ns += dt_ns
+            self._recent_latency.append(dt_ns)
+            if self.latency:
+                est_us = (sum(self._recent_latency)
+                          / len(self._recent_latency) / 1e3)
+                self.latency_us = est_us
+                # re-report when the rolling estimate drifts past the 5%
+                # headroom or improves by more than 25%
+                # (≙ tensor_filter.c:490-527 re-reporting thresholds)
+                rep = self._reported_latency_us
+                if rep is None or est_us > rep * _LATENCY_REPORT_HEADROOM \
+                        or est_us < rep * _LATENCY_IMPROVE_THRESHOLD:
+                    self._reported_latency_us = est_us
+                    report_us = est_us
+        if report_us is not None:
+            self.post_message("latency", latency_us=report_us)
 
-    def _maybe_report_latency(self, est_us: float) -> None:
-        """Post a LATENCY bus message when the rolling estimate drifts
-        past the 5% headroom or improves by more than 25%
-        (≙ tensor_filter.c:490-527 re-reporting thresholds)."""
-        rep = self._reported_latency_us
-        if rep is None or est_us > rep * _LATENCY_REPORT_HEADROOM \
-                or est_us < rep * _LATENCY_IMPROVE_THRESHOLD:
-            self._reported_latency_us = est_us
-            self.post_message("latency", latency_us=est_us)
+    def _record_dispatch(self, dt_ns: int) -> None:
+        """Record one frame's dispatch-to-RETURN time (the chain-thread
+        cost). Synchronously it equals the completion latency; under a
+        window it is near-zero — surfacing both is what makes the
+        overlap visible instead of silently misreported."""
+        with self._stats_lock:
+            self._dispatch_count += 1
+            self._total_dispatch_ns += dt_ns
+            self._recent_dispatch.append(dt_ns)
 
     def latency_average_us(self) -> float:
-        """Rolling average over the last 10 invokes, µs
-        (≙ latency property, tensor_filter.c:408-448)."""
-        if not self._recent_latency:
-            return 0.0
-        return sum(self._recent_latency) / len(self._recent_latency) / 1e3
+        """Rolling dispatch-to-completion average over the last 10
+        frames, µs (≙ latency property, tensor_filter.c:408-448)."""
+        with self._stats_lock:
+            if not self._recent_latency:
+                return 0.0
+            return (sum(self._recent_latency)
+                    / len(self._recent_latency) / 1e3)
+
+    def dispatch_average_us(self) -> float:
+        """Rolling dispatch-to-return average over the last 10 frames,
+        µs — the chain-thread cost per frame under the window."""
+        with self._stats_lock:
+            if not self._recent_dispatch:
+                return 0.0
+            return (sum(self._recent_dispatch)
+                    / len(self._recent_dispatch) / 1e3)
 
     def throughput_fps(self) -> float:
         """Invokes/sec since start (≙ throughput prop, tensor_filter.c:452)."""

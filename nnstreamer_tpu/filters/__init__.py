@@ -1,6 +1,6 @@
 """Filter-backend subplugins (L5) and their registry (L2)."""
 from . import (custom, custom_c, jax_backend, llm,  # noqa: F401
-               onnx_backend, tf_backend, tflite_backend,
+               onnx_backend, simlink, tf_backend, tflite_backend,
                torch_backend)  # (register built-in backends)
 from .base import (Accelerator, FilterEvent, FilterFramework,
                    FilterProperties, InvokeDrop)

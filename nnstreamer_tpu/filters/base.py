@@ -122,6 +122,12 @@ class FilterFramework:
     # (the element then negotiates aggregator-stacked streams); backends
     # that lower to a fixed model shape must leave this False
     SUPPORTS_BATCH = False
+    # True when the backend can split invoke into a non-blocking
+    # dispatch() and a blocking complete() — what the element's K-frame
+    # in-flight window (in-flight property) is built on. Backends whose
+    # invoke is inherently synchronous leave this False; the element
+    # then ignores the window and stays synchronous.
+    SUPPORTS_DISPATCH = False
 
     def open(self, props: FilterProperties) -> None:
         raise NotImplementedError
@@ -131,6 +137,25 @@ class FilterFramework:
 
     def invoke(self, inputs: Sequence[Any]) -> List[Any]:
         raise NotImplementedError
+
+    # overlapped execution -------------------------------------------------
+    def dispatch(self, inputs: Sequence[Any], donate: bool = False
+                 ) -> Any:
+        """Enqueue one frame's device program WITHOUT waiting for the
+        results; returns an opaque in-flight handle for
+        :meth:`complete`. ``donate`` permits input/output buffer
+        aliasing for inputs the backend itself staged (platform
+        permitting). The default implementation degrades to a
+        synchronous invoke — the handle IS the outputs — so a window of
+        K over a non-async backend is merely useless, never wrong."""
+        return self.invoke(inputs)
+
+    def complete(self, handle: Any) -> List[Any]:
+        """Block until a dispatched frame's outputs are materialized
+        enough to hand downstream; raises if the device program failed.
+        Called from the element's completer thread — implementations
+        must be safe to run concurrently with :meth:`dispatch`."""
+        return handle
 
     def get_model_info(self) -> Tuple[Optional[TensorsInfo], Optional[TensorsInfo]]:
         """(input_info, output_info); either may be None if the backend

@@ -65,6 +65,14 @@ class JaxFilter(FilterFramework):
     NAME = "jax"
     EXTENSIONS = (".py", ".jaxm", ".msgpack")
     SUPPORTS_BATCH = True  # apply fns broadcast over a leading batch dim
+    # JAX dispatch is async on every backend: dispatch() below returns
+    # as soon as the executable is enqueued, complete() blocks — the
+    # split the element's in-flight window is built on
+    SUPPORTS_DISPATCH = True
+
+    # platforms where jax.jit honors donate_argnums (CPU logs a warning
+    # per donated arg and ignores it — gate rather than spam)
+    _DONATION_PLATFORMS = ("tpu", "gpu")
 
     def __init__(self):
         self._apply: Optional[Callable] = None
@@ -144,11 +152,16 @@ class JaxFilter(FilterFramework):
         return self._in_info, self._out_info
 
     # -- invoke -----------------------------------------------------------
-    def _executable(self, sig: Tuple) -> Callable:
+    def _executable(self, sig: Tuple,
+                    donate_idx: Tuple[int, ...] = ()) -> Callable:
         """One compiled executable per input signature (shape/dtype tuple).
         Recompile-on-new-signature is the static-shape answer to dynamic
-        models (SURVEY.md §7 hard part (a))."""
-        exe = self._jit_cache.get(sig)
+        models (SURVEY.md §7 hard part (a)). ``donate_idx`` (1-based:
+        arg 0 is params, which are NEVER donated) selects inputs whose
+        device buffers XLA may alias into the outputs; it is part of the
+        cache key because donation changes the compiled program."""
+        key = (sig, donate_idx) if donate_idx else sig
+        exe = self._jit_cache.get(key)
         if exe is None:
             import jax
             fn = self._apply
@@ -156,8 +169,9 @@ class JaxFilter(FilterFramework):
             def call(params, *xs):
                 return fn(params, *xs)
 
-            exe = jax.jit(call)
-            self._jit_cache[sig] = exe
+            exe = jax.jit(call, donate_argnums=donate_idx) if donate_idx \
+                else jax.jit(call)
+            self._jit_cache[key] = exe
         return exe
 
     def _input_sharding(self, x):
@@ -189,6 +203,59 @@ class JaxFilter(FilterFramework):
                       for x in inputs]
             sig = tuple((tuple(x.shape), str(x.dtype)) for x in xs)
             out = self._executable(sig)(self._params, *xs)
+        if isinstance(out, (list, tuple)):
+            return list(out)
+        return [out]
+
+    # -- overlapped execution ---------------------------------------------
+    def dispatch(self, inputs: Sequence[Any], donate: bool = False) -> Any:
+        """Enqueue one frame's executable and return the (still
+        materializing) output arrays as the in-flight handle — JAX
+        dispatch is async, so this returns as soon as XLA has the
+        program queued; errors surface in :meth:`complete`.
+
+        Donation: with ``donate=True`` the H2D staging buffers of inputs
+        THIS call uploaded are donated to the executable
+        (input/output aliasing — the double-buffered H2D leg reuses its
+        staging buffer for the outputs instead of allocating fresh HBM
+        per in-flight frame). Device-resident inputs are upstream-owned
+        and never donated; params (arg 0) never either. Gated to
+        platforms where XLA honors donation — CPU ignores it with a
+        warning per arg."""
+        import jax
+        with self._lock:
+            if self._suspended:
+                self._resume()
+            donate_idx: Tuple[int, ...] = ()
+            if self._mesh is not None:
+                xs = [jax.device_put(
+                          x if isinstance(x, jax.Array) else np.asarray(x),
+                          self._input_sharding(x))
+                      for x in inputs]
+            else:
+                xs = []
+                staged: List[int] = []
+                for i, x in enumerate(inputs):
+                    if isinstance(x, jax.Array):
+                        xs.append(x)
+                    else:
+                        xs.append(jax.device_put(np.asarray(x),
+                                                 self._device))
+                        staged.append(i + 1)  # 1-based: arg 0 is params
+                if donate and staged \
+                        and self._device.platform in self._DONATION_PLATFORMS:
+                    donate_idx = tuple(staged)
+            sig = tuple((tuple(x.shape), str(x.dtype)) for x in xs)
+            out = self._executable(sig, donate_idx)(self._params, *xs)
+        return out
+
+    def complete(self, handle: Any) -> List[Any]:
+        """Block until a dispatched frame's outputs are on-device
+        materialized (raises the deferred device error, if any). Takes
+        no lock: runs on the completer thread concurrently with
+        dispatch — block_until_ready only touches the arrays."""
+        import jax
+        out = jax.block_until_ready(handle)
         if isinstance(out, (list, tuple)):
             return list(out)
         return [out]

@@ -25,6 +25,7 @@ convention. Stats live in the locked :class:`utils.atomic.Counters`
 """
 from __future__ import annotations
 
+import threading
 import time
 from typing import Callable, List, Optional
 
@@ -32,6 +33,7 @@ from ..pipeline.element import TransformElement
 from ..pipeline.events import CapsEvent, QosEvent
 from ..pipeline.pad import Pad
 from ..tensors.buffer import Buffer, Chunk
+from ..tensors.transfer import submit_fetch
 from ..utils.log import logger
 
 
@@ -84,6 +86,25 @@ class FusedSegment(TransformElement):
         retries = [float(getattr(m, "breaker_retry_after_ms", 0) or 0)
                    for m in members]
         self.breaker_retry_after_ms = max(retries) if retries else 100.0
+        # overlapped execution: the widest member window wins (the run
+        # was device-capable end to end, so one window governs the fused
+        # program); reorder stays on unless EVERY member opted out
+        self.in_flight = max(
+            (int(getattr(m, "in_flight", 1) or 1) for m in members),
+            default=1)
+        self.reorder = all(bool(getattr(m, "reorder", True))
+                           for m in members)
+        self.reorder_deadline_ms = max(
+            (float(getattr(m, "reorder_deadline_ms", 1000.0) or 1000.0)
+             for m in members), default=1000.0)
+        self._overlap = None
+        # completion errors are latched by the completer and re-raised
+        # on the NEXT frame's chain (so Element.chain applies the
+        # on-error policy on the chain thread, one frame late); two
+        # roles store the field — completer sets, chain clears — so a
+        # plain store is not enough: the lock makes the handoff atomic
+        self._err_lock = threading.Lock()
+        self._pending_error: Optional[BaseException] = None
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> None:
@@ -96,9 +117,28 @@ class FusedSegment(TransformElement):
                 name=self.name, on_transition=self._on_breaker_transition)
         else:
             self._breaker = None
+        self._overlap = None
+        if int(self.in_flight) > 1:
+            from ..elements.overlap import OverlapExecutor
+            self._overlap = OverlapExecutor(
+                int(self.in_flight),
+                complete_cb=self._complete_frame,
+                error_cb=self._complete_error,
+                push_cb=self.push,
+                name=self.name,
+                reorder=bool(self.reorder),
+                reorder_deadline_s=float(self.reorder_deadline_ms) / 1e3)
+
+    def drain(self) -> None:
+        super().drain()
+        if self._overlap is not None:
+            self._overlap.flush()
 
     def stop(self) -> None:
         super().stop()
+        if self._overlap is not None:
+            self._overlap.flush()
+            self._overlap.stop()
         self._programs.clear()
 
     def _on_breaker_transition(self, old: str, new: str) -> None:
@@ -129,6 +169,16 @@ class FusedSegment(TransformElement):
 
     # -- dataflow ---------------------------------------------------------
     def do_chain(self, pad: Pad, buf: Buffer) -> None:
+        if self._overlap is not None:
+            # a completion error latched by the completer surfaces HERE,
+            # one frame late, so Element.chain applies the segment's
+            # on-error policy on the chain thread exactly as it would
+            # for a synchronous failure (the failed frame itself was
+            # already accounted dropped by _complete_error)
+            with self._err_lock:
+                err, self._pending_error = self._pending_error, None
+            if err is not None:
+                raise err
         if self._breaker is not None and not self._breaker.allow():
             self._shed_frame(buf)
             return
@@ -142,6 +192,9 @@ class FusedSegment(TransformElement):
         else:
             self.stats.inc("jit_hits")
         try:
+            # jit tracing/compilation errors surface here on the chain
+            # thread in BOTH modes; with a window the device execution
+            # itself is still in flight when this returns
             outs = exe(arrays)
         except Exception:
             # device program failed (trace or dispatch): count it on
@@ -151,18 +204,60 @@ class FusedSegment(TransformElement):
                 self._breaker.record_failure()
             raise
         self._programs[sig] = exe
-        if self._breaker is not None:
-            self._breaker.record_success()
         dt = time.perf_counter_ns() - t0
         tracer = getattr(self.pipeline, "tracer", None)
         if tracer is not None:
             tracer.observe(f"fusion/{self.name}", dt)
+        if self._overlap is not None:
+            t_disp = self._overlap.window.acquire()
+            self._overlap.submit(buf, outs, t_disp)
+            return
+        if self._breaker is not None:
+            self._breaker.record_success()
+        self.push(buf.with_chunks(self._out_chunks(outs)))
+
+    def _out_chunks(self, outs) -> List[Chunk]:
         if not isinstance(outs, (list, tuple)):
             outs = [outs]
         if self._prefetch:
-            from ..tensors.fetch import submit_fetch
             outs = submit_fetch(outs)
-        self.push(buf.with_chunks([Chunk(o) for o in outs]))
+        return [Chunk(o) for o in outs]
+
+    # -- completer side (in-flight window) --------------------------------
+    def _complete_frame(self, entry) -> Buffer:
+        """Materialize one in-flight fused program's outputs; raises the
+        deferred device error, routed to :meth:`_complete_error`. No
+        donation for segment programs: member activations alias through
+        the fused XLA program already; input donation would invalidate
+        upstream-owned device buffers."""
+        import jax
+        outs = jax.block_until_ready(entry.payload)
+        if self._breaker is not None:
+            self._breaker.record_success()
+        return entry.buf.with_chunks(self._out_chunks(outs))
+
+    def _complete_error(self, entry, exc: BaseException) -> None:
+        """Per-frame accounting for a deferred device failure, then
+        latch the error for the chain thread to re-raise."""
+        if self._breaker is not None:
+            self._breaker.record_failure()
+        self.stats.inc("dropped")
+        logger.warning("%s: fused program failed at completion (frame "
+                       "dropped): %s", self.name, exc)
+        with self._err_lock:
+            if self._pending_error is None:
+                self._pending_error = exc
+
+    def handle_event(self, pad: Pad, event) -> None:
+        if self._overlap is not None:
+            # serialized events must not overtake in-flight frames
+            self._overlap.flush()
+        super().handle_event(pad, event)
+
+    def transfer_report(self) -> dict:
+        """Window occupancy / overlap stats for trace.report()'s
+        ``transfer`` block; {} when running synchronously."""
+        return self._overlap.report() if self._overlap is not None else {}
 
     def _compile(self):
         import jax

@@ -1,245 +1,26 @@
-"""Coalescing device->host fetch service.
+"""Coalescing device->host fetch service — compat façade.
 
-On a tunneled dev chip a D2H fetch of a *computed* result costs a full
-RPC round trip (measured 10-100 ms of latency depending on link weather,
-regardless of payload size; ``copy_to_host_async`` does not hide it). A
-pipeline whose decoder fetches one frame at a time is therefore capped
-at ~1/RTT fps no matter how fast the model runs.
-
-The fix is architectural: the filter enqueues each frame's outputs with
-one :func:`submit_fetch` call and pushes the frame downstream
-immediately, carrying :class:`PendingHost` handles instead of arrays. A
-single fetcher thread drains **everything queued** into one batched
-``jax.device_get`` per RPC — adaptive batching: at high fps many frames
-share one round trip, at low fps each frame pays one. Measured on the
-tunnel: 6.4 ms/frame sustained vs 85-100 ms/frame for frame-at-a-time
-fetching, and unlike a fetch *pool* it cannot congest the link with N
-concurrent RPCs.
-
-Residency: a pending handle still carries its device array, so chained
-device-side consumers (a second filter, an accelerated transform) keep
-HBM residency and never wait on the fetch; only host boundaries block.
-HBM lifetime is unchanged from a plain device-resident chunk — the
-buffer is released when the handle resolves or the frame is dropped.
-
-The reference has no analog (host pointers are free there); this is the
-TPU-native cost model talking (SURVEY.md §7 hard part (b): device
-residency, materialize only at host boundaries — here even the
-materialization is pipelined and batched).
+The one-way D2H fetcher grew into the bidirectional transfer service in
+:mod:`nnstreamer_tpu.tensors.transfer` (download + upload coalescing,
+per-link in-flight windows). This module keeps the historical import
+surface — ``submit_fetch`` / ``resolve`` / ``PendingHost`` /
+``fetch_stats`` — alive for existing callers; new code should import
+from ``tensors.transfer`` directly.
 """
 from __future__ import annotations
 
-import threading
-from typing import Any, List, Optional, Sequence
+from .transfer import (  # noqa: F401 — re-exported compat surface
+    _MAX_ARRAYS_PER_RPC,
+    PendingHost,
+    _Coalescer,
+    _Downloader,
+    _Ticket,
+    _downloader,
+    fetch_stats,
+    resolve,
+    submit_fetch,
+)
 
-import numpy as np
-
-# cap on arrays per RPC so one giant drain can't add unbounded latency
-# to the frames queued behind it
-_MAX_ARRAYS_PER_RPC = 256
-
-
-class _Ticket:
-    """One frame's fetch: a list of device arrays -> host arrays."""
-
-    __slots__ = ("arrays", "results", "error", "_evt")
-
-    def __init__(self, arrays: List[Any]):
-        self.arrays: Optional[List[Any]] = arrays
-        self.results: Optional[List[np.ndarray]] = None
-        self.error: Optional[BaseException] = None
-        self._evt = threading.Event()
-
-    @property
-    def done(self) -> bool:
-        return self._evt.is_set()
-
-    def _deliver(self, results: Optional[List[np.ndarray]],
-                 error: Optional[BaseException] = None) -> None:
-        self.results = results
-        self.error = error
-        self.arrays = None  # the fetcher's refs go; HBM lifetime is now
-        self._evt.set()     # governed by the PendingHost handles alone
-
-    def wait(self) -> List[np.ndarray]:
-        self._evt.wait()
-        if self.error is not None:
-            raise self.error
-        assert self.results is not None
-        return self.results
-
-
-class _Coalescer:
-    def __init__(self):
-        self._q: List[_Ticket] = []
-        self._cv = threading.Condition()
-        self._thread: Optional[threading.Thread] = None
-        # achieved-depth accounting: frames (tickets) per device_get RPC
-        # is THE number that says whether the service actually amortizes
-        # the link round trip (1.0 = degenerated to frame-at-a-time)
-        self._stats = {"rpcs": 0, "frames": 0, "arrays": 0}
-
-    def stats(self, reset: bool = False) -> dict:
-        with self._cv:
-            out = dict(self._stats)
-            if reset:
-                self._stats.update(rpcs=0, frames=0, arrays=0)
-        out["frames_per_rpc_avg"] = (
-            out["frames"] / out["rpcs"] if out["rpcs"] else 0.0)
-        return out
-
-    def _account(self, n_tickets: int, n_arrays: int) -> None:
-        with self._cv:
-            self._stats["rpcs"] += 1
-            self._stats["frames"] += n_tickets
-            self._stats["arrays"] += n_arrays
-
-    def _ensure_thread(self) -> None:
-        if self._thread is None or not self._thread.is_alive():
-            self._thread = threading.Thread(
-                target=self._run, name="nns-fetch", daemon=True)
-            self._thread.start()
-
-    def submit(self, ticket: _Ticket) -> None:
-        with self._cv:
-            self._ensure_thread()
-            self._q.append(ticket)
-            self._cv.notify()
-
-    def _run(self) -> None:
-        import time as _time
-
-        import jax
-        last_rpc = 0.0
-        while True:
-            with self._cv:
-                while not self._q:
-                    self._cv.wait()
-            # adaptive linger (Nagle-style): on a slow link, draining the
-            # instant the first ticket lands races the pipeline's refill
-            # — the sink frees queue slots only when THIS delivery runs,
-            # so tickets submitted a millisecond after the drain wait a
-            # whole extra round trip. A pause of 5% of the last RPC
-            # (capped 4 ms) lets stragglers join. The worst case is
-            # bounded by construction: the pause never exceeds 5% of the
-            # measured RPC time, so even a fast link moving big payloads
-            # pays <=5% slower cadence, repaid by any batching gain at
-            # all; tiny-payload RPCs (the latency-sensitive case) have
-            # tiny durations and skip the pause entirely. Measured:
-            # ~1.7-1.9x devres pipeline fps at ~100 ms RTT, unchanged at
-            # sub-ms RTT. Skipped when the backlog already fills an RPC
-            # — waiting could not deepen that batch, only delay it.
-            linger = min(0.004, last_rpc * 0.05)
-            if linger > 0.0005:
-                with self._cv:
-                    backlog = sum(len(t.arrays or ()) for t in self._q)
-                if backlog < _MAX_ARRAYS_PER_RPC:
-                    _time.sleep(linger)
-            with self._cv:
-                grab: List[_Ticket] = []
-                n = 0
-                while self._q and n < _MAX_ARRAYS_PER_RPC:
-                    t = self._q.pop(0)
-                    grab.append(t)
-                    n += len(t.arrays or ())
-            flat = [a for t in grab for a in (t.arrays or ())]
-            t0 = _time.perf_counter()
-            try:
-                host = jax.device_get(flat)
-                last_rpc = _time.perf_counter() - t0
-                self._account(len(grab), len(flat))
-            except BaseException:  # noqa: BLE001 - isolate per frame below
-                # one poisoned array (donated buffer, transient RPC error)
-                # must not fail every frame sharing the RPC: retry each
-                # ticket alone so only the genuinely bad frame errors out.
-                # The failed round trip still cost a full RTT: count it
-                # (0 frames delivered) so frames_per_rpc_avg cannot read
-                # BETTER than reality on an unhealthy link; account each
-                # retry before delivering so a resolve-then-reset caller
-                # never sees counts land after its reset. The failed
-                # attempt still measured real link time — keep the
-                # linger's RPC estimate live through error storms.
-                last_rpc = _time.perf_counter() - t0
-                self._account(0, 0)
-                for t in grab:
-                    t1 = _time.perf_counter()
-                    try:
-                        host1 = jax.device_get(t.arrays or [])
-                        last_rpc = _time.perf_counter() - t1
-                        self._account(1, len(t.arrays or ()))
-                        t._deliver(host1)
-                    except BaseException as exc:  # noqa: BLE001
-                        self._account(0, 0)
-                        t._deliver(None, exc)
-                continue
-            i = 0
-            for t in grab:
-                k = len(t.arrays or ())
-                t._deliver(host[i:i + k])
-                i += k
-
-
-_coalescer = _Coalescer()
-
-
-class PendingHost:
-    """A device array whose host copy is in flight.
-
-    Shape/dtype are known immediately (from the array's aval, no sync);
-    :meth:`resolve` blocks until the coalescer's ``device_get`` lands.
-    One ticket is shared by every output of a frame. ``dev`` keeps the
-    device array reachable so device-side consumers stay in HBM without
-    waiting; it is dropped at first resolution.
-    """
-
-    __slots__ = ("_ticket", "_index", "dev", "shape", "dtype")
-
-    def __init__(self, ticket: _Ticket, index: int, dev):
-        self._ticket = ticket
-        self._index = index
-        self.dev = dev
-        self.shape = tuple(dev.shape)
-        self.dtype = np.dtype(dev.dtype)
-
-    @property
-    def ndim(self) -> int:
-        return len(self.shape)
-
-    @property
-    def done(self) -> bool:
-        return self._ticket.done
-
-    def resolve(self) -> np.ndarray:
-        out = self._ticket.wait()[self._index]
-        self.dev = None
-        return out
-
-
-def submit_fetch(outputs: Sequence[Any]) -> List[Any]:
-    """Enqueue one coalesced fetch for all device-resident outputs of a
-    frame; host arrays pass through untouched. Returns the outputs with
-    device arrays replaced by :class:`PendingHost` handles."""
-    import jax
-
-    dev_idx = [i for i, o in enumerate(outputs)
-               if isinstance(o, jax.Array)]
-    if not dev_idx:
-        return list(outputs)
-    ticket = _Ticket([outputs[i] for i in dev_idx])
-    _coalescer.submit(ticket)
-    wrapped = list(outputs)
-    for slot, i in enumerate(dev_idx):
-        wrapped[i] = PendingHost(ticket, slot, outputs[i])
-    return wrapped
-
-
-def resolve(x: Any) -> Any:
-    """Materialize ``x`` if it is a pending fetch; identity otherwise."""
-    return x.resolve() if isinstance(x, PendingHost) else x
-
-
-def fetch_stats(reset: bool = False) -> dict:
-    """Coalescer counters: rpcs / frames / arrays since start (or last
-    reset) plus ``frames_per_rpc_avg``, the achieved batching depth —
-    the observability hook for "is the RTT actually being amortized"."""
-    return _coalescer.stats(reset=reset)
+# historical name for the download-side singleton (tests drive it
+# directly to pin per-ticket error isolation)
+_coalescer = _downloader

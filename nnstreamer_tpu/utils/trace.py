@@ -236,6 +236,9 @@ class Tracer:
             fusion = self._fusion_block(pipeline, out)
             if fusion:
                 out["fusion"] = fusion
+            transfer = self._transfer_block(pipeline)
+            if transfer:
+                out["transfer"] = transfer
         # control-plane counters: any live in-process discovery broker
         # (register/query/error totals) surfaces next to the elements
         try:
@@ -282,3 +285,42 @@ class Tracer:
             "jit_misses": sum(s["jit_misses"] for s in segments.values()),
             "per_segment": segments,
         }
+
+    @staticmethod
+    def _transfer_block(pipeline) -> Dict[str, Any]:
+        """The overlapped-execution view: per-element in-flight window
+        stats (occupancy, overlap ratio — from each element's
+        ``transfer_report()``) plus the bidirectional coalescing
+        service's achieved depths (upload/download frames-per-RPC).
+        {} when nothing overlapped or coalesced, so existing reports
+        are unchanged."""
+        out: Dict[str, Any] = {}
+        windows: Dict[str, Any] = {}
+        for name, el in pipeline.elements.items():
+            rep = getattr(el, "transfer_report", None)
+            if callable(rep):
+                try:
+                    r = rep()
+                except Exception:  # noqa: BLE001 — reporting never raises
+                    continue
+                if r:
+                    windows[name] = r
+        if windows:
+            out["windows"] = windows
+            ratios = [w["overlap_ratio"] for w in windows.values()
+                      if w.get("overlap_ratio")]
+            if ratios:
+                out["overlap_ratio"] = round(max(ratios), 2)
+        try:
+            from ..tensors.transfer import transfer_stats
+            svc = transfer_stats()
+            for direction, st in svc.items():
+                if st.get("rpcs"):
+                    out[direction] = {
+                        "rpcs": st["rpcs"], "frames": st["frames"],
+                        "arrays": st["arrays"],
+                        "coalesce_avg": round(st["frames_per_rpc_avg"], 2),
+                    }
+        except Exception:  # noqa: BLE001 — observability must not raise
+            pass
+        return out

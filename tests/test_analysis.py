@@ -374,6 +374,52 @@ class TestRules:
               "replicas=localhost:3001 affinity=false session=false")
         assert findings_for(ok, "router-affinity-sessionless") == []
 
+    # -- async-window (overlapped executor, ISSUE 9) ----------------------
+    def test_async_window_zero_is_error(self):
+        bad = (  # pipelint: skip — a 0-frame window never admits a frame
+            f"tensortestsrc caps={CAPS_F32} ! "
+            "tensor_filter name=f framework=jax model=zoo://mlp "
+            "in-flight=0 ! fakesink")
+        got = findings_for(bad, "async-window")
+        assert [(f.element, f.severity) for f in got] == \
+            [("f", Severity.ERROR)]
+        assert "never admit" in got[0].message
+
+    def test_async_window_exceeding_bucket_budget_is_error(self):
+        bad = (  # pipelint: skip — window 16 > the signature budget of 8
+            "tensor_serve_src name=s buckets=1,2,4 max-queue=16 ! "
+            "tensor_filter name=f framework=jax model=zoo://mlp "
+            "in-flight=16 ! tensor_serve_sink")
+        got = findings_for(bad, "async-window")
+        assert [(f.element, f.severity) for f in got] == \
+            [("f", Severity.ERROR)]
+        assert "jit-signature budget" in got[0].message
+
+    def test_async_window_wide_but_unbucketed_is_clean(self):
+        ok = (f"tensortestsrc caps={CAPS_F32} ! "
+              "tensor_filter name=f framework=jax model=zoo://mlp "
+              "in-flight=16 ! fakesink")
+        assert findings_for(ok, "async-window") == []
+
+    def test_async_window_no_reorder_into_aggregator_warns(self):
+        bad = (  # pipelint: skip — unordered completions into a stacker
+            f"tensortestsrc caps={CAPS_F32} ! "
+            "tensor_filter name=f framework=jax model=zoo://mlp "
+            "in-flight=4 reorder=false ! queue ! "
+            "tensor_aggregator name=agg frames-out=2 ! fakesink")
+        got = findings_for(bad, "async-window")
+        assert [(f.element, f.severity) for f in got] == \
+            [("f", Severity.WARNING)]
+        assert "order-sensitive" in got[0].message
+        assert "agg" in got[0].message
+
+    def test_async_window_with_reorder_into_aggregator_is_clean(self):
+        ok = (f"tensortestsrc caps={CAPS_F32} ! "
+              "tensor_filter name=f framework=jax model=zoo://mlp "
+              "in-flight=4 ! queue ! "
+              "tensor_aggregator frames-out=2 ! fakesink")
+        assert findings_for(ok, "async-window") == []
+
 
 CLEAN_CORPUS = [
     # straight filter chain on fixed caps

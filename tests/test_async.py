@@ -1,0 +1,395 @@
+"""Overlapped execution: the K-frame in-flight window (ISSUE 9).
+
+Unit-pins the reorder buffer and window semantics, then drives real
+pipelines over the deterministic ``simlink`` backend: byte parity
+against the synchronous path, PTS monotonicity under a window with an
+injected slow frame, zero-loss accounting under injected completion
+failures, the split dispatch/completion latency metrics, upload-side
+coalescing, and the runtime lock validator over the new
+dispatcher/completer roles.
+"""
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import parse_launch
+from nnstreamer_tpu.elements.overlap import OverlapExecutor, ReorderBuffer
+from nnstreamer_tpu.tensors.transfer import (InFlightWindow,
+                                             set_simulated_rtt_ms,
+                                             submit_upload, transfer_stats)
+
+CAPS = ("other/tensors,format=static,num_tensors=1,"
+        "types=(string)float32,dimensions=(string)8,"
+        "framerate=(fraction)30/1")
+
+
+class _Item:
+    def __init__(self, pts):
+        self.pts = pts
+
+
+# ---------------------------------------------------------- reorder buffer
+
+class TestReorderBuffer:
+    def test_in_order_passthrough(self):
+        rb = ReorderBuffer()
+        a, b = _Item(0), _Item(1)
+        assert rb.push(0, a) == [a]
+        assert rb.push(1, b) == [b]
+        assert rb.released == 2 and len(rb) == 0
+
+    def test_out_of_order_restored(self):
+        rb = ReorderBuffer()
+        items = [_Item(i) for i in range(4)]
+        assert rb.push(2, items[2]) == []
+        assert rb.push(1, items[1]) == []
+        assert rb.push(0, items[0]) == items[:3]
+        assert rb.push(3, items[3]) == [items[3]]
+        assert rb.released == 4 and rb.pts_regressions == 0
+
+    def test_skip_advances_past_error_gap(self):
+        rb = ReorderBuffer()
+        late = _Item(2)
+        assert rb.push(2, late) == []
+        assert rb.push(0, _Item(0)) != []
+        # seq 1 errored: later frames must not wait for it
+        assert rb.skip(1) == [late]
+        assert rb.skipped == 1 and rb.released == 2
+
+    def test_stall_deadline_abandons_gap(self):
+        rb = ReorderBuffer(deadline_s=1.0)
+        held = _Item(5)
+        rb.push(5, held, now=100.0)
+        # before the deadline the gap dams the stream
+        assert rb.poll(now=100.5) == []
+        # past it, the missing seq 0..4 are abandoned (counted)
+        assert rb.poll(now=101.5) == [held]
+        assert rb.stalls == 1 and rb.released == 1
+
+    def test_flush_releases_everything_in_order(self):
+        rb = ReorderBuffer()
+        a, c = _Item(0), _Item(2)
+        rb.push(2, c)
+        rb.push(0, a)
+        # seq 0 drained eagerly; flush releases the gapped seq 2
+        assert rb.flush() == [c]
+        assert rb.released == 2 and len(rb) == 0
+
+    def test_pts_regression_counted_not_hidden(self):
+        rb = ReorderBuffer()
+        first, second = _Item(100), _Item(50)  # upstream sent bad PTS
+        out = rb.push(0, first) + rb.push(1, second)
+        assert out == [first, second]  # released anyway, but counted
+        assert rb.pts_regressions == 1
+
+
+# ------------------------------------------------------- in-flight window
+
+class TestInFlightWindow:
+    def test_backpressure_blocks_at_limit(self):
+        w = InFlightWindow(2)
+        t1 = w.acquire()
+        t2 = w.acquire()
+        assert t1 is not None and t2 is not None
+        assert w.acquire(timeout=0.05) is None  # full: caller blocks
+        w.release(t1)
+        t3 = w.acquire(timeout=1.0)
+        assert t3 is not None
+        w.release(t2)
+        w.release(t3)
+        assert w.idle()
+
+    def test_report_tracks_occupancy_and_overlap(self):
+        w = InFlightWindow(4)
+        ts = [w.acquire() for _ in range(3)]
+        time.sleep(0.02)
+        for t in ts:
+            w.release(t)
+        rep = w.report()
+        assert rep["window"] == 4
+        assert rep["in_flight_peak"] == 3
+        assert rep["in_flight"] == 0
+        # 3 frames in flight for the whole span -> ratio ~3
+        assert rep["overlap_ratio"] > 1.5
+
+
+# ------------------------------------------------------- overlap executor
+
+class TestOverlapExecutor:
+    def _make(self, limit=4, complete=None, error=None, **kw):
+        pushed = []
+        ex = OverlapExecutor(
+            limit,
+            complete_cb=complete or (lambda e: e.buf),
+            error_cb=error or (lambda e, exc: None),
+            push_cb=pushed.append, **kw)
+        return ex, pushed
+
+    def test_frames_complete_and_push_in_order(self):
+        ex, pushed = self._make()
+        for i in range(8):
+            t = ex.window.acquire()
+            ex.submit(_Item(i), None, t)
+        assert ex.flush()
+        ex.stop()
+        assert [b.pts for b in pushed] == list(range(8))
+        rep = ex.report()
+        assert rep["completed"] == 8 and rep["errors"] == 0
+        assert rep["reorder"]["released"] == 8
+
+    def test_error_frames_account_and_do_not_dam(self):
+        errs = []
+
+        def complete(entry):
+            if entry.buf.pts == 1:
+                raise RuntimeError("boom")
+            return entry.buf
+
+        ex, pushed = self._make(complete=complete,
+                                error=lambda e, exc: errs.append(e.buf.pts))
+        for i in range(4):
+            ex.submit(_Item(i), None, ex.window.acquire())
+        assert ex.flush()
+        ex.stop()
+        assert errs == [1]
+        assert [b.pts for b in pushed] == [0, 2, 3]
+        rep = ex.report()
+        assert rep["errors"] == 1 and rep["completed"] == 3
+        assert rep["reorder"]["skipped"] == 1
+
+    def test_push_failure_releases_the_window_slot(self):
+        ex = OverlapExecutor(
+            2, complete_cb=lambda e: e.buf,
+            error_cb=lambda e, exc: None,
+            push_cb=lambda b: (_ for _ in ()).throw(RuntimeError("sink")))
+        for i in range(4):  # 2x the window: slots must recycle
+            ex.submit(_Item(i), None, ex.window.acquire())
+        assert ex.flush()
+        ex.stop()
+        assert ex.report()["push_errors"] == 4
+
+
+# ------------------------------------------------------ pipeline (simlink)
+
+def _run_simlink(n=12, custom="rtt:30,svc:2", extra="", timeout=60):
+    p = parse_launch(
+        f'tensortestsrc name=src caps="{CAPS}" num-buffers={n} '
+        f'pattern=counter ! queue max-size-buffers=4 '
+        f'! tensor_filter name=f framework=simlink '
+        f'custom={custom} {extra} ! appsink name=out')
+    p.fuse = False
+    p.run(timeout=timeout)
+    return p
+
+
+def _bytes_of(p):
+    return [tuple(np.ascontiguousarray(c.host()).tobytes()
+                  for c in b.chunks) for b in p["out"].pop_all()]
+
+
+class TestSimlinkPipeline:
+    def test_async_matches_sync_bytes_and_is_faster(self):
+        t0 = time.perf_counter()
+        sync = _run_simlink(extra="in-flight=1")
+        t_sync = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        ovl = _run_simlink(extra="in-flight=8")
+        t_async = time.perf_counter() - t0
+        sb, ab = _bytes_of(sync), _bytes_of(ovl)
+        assert len(ab) == 12
+        assert ab == sb
+        # 12 frames * 32ms serial ≈ 384ms sync; windowed ≈ rtt + 12*svc
+        assert t_async < t_sync
+
+    def test_pts_monotonic_with_window_and_slow_frame(self):
+        from nnstreamer_tpu.filters.simlink import SimLinkFilter
+        orig = SimLinkFilter.complete
+
+        def slow_complete(self, handle):
+            if handle[2] == 3:  # frame 3 straggles on the link
+                time.sleep(0.2)
+            return orig(self, handle)
+
+        SimLinkFilter.complete = slow_complete
+        try:
+            p = _run_simlink(extra="in-flight=6")
+        finally:
+            SimLinkFilter.complete = orig
+        bufs = p["out"].pop_all()
+        assert len(bufs) == 12
+        pts = [b.pts for b in bufs]
+        assert pts == sorted(pts), f"PTS went backwards: {pts}"
+        rep = p["f"].transfer_report()
+        assert rep["reorder"]["pts_regressions"] == 0
+        assert rep["completed"] == 12
+
+    def test_zero_loss_accounting_with_completion_failures(self):
+        """fail-every=5 raises INSIDE completion with frames in flight:
+        every admitted frame must settle exactly once — pushed or
+        accounted dropped — and the breaker must see the failures."""
+        p = _run_simlink(n=20, custom="rtt:20,svc:1,fail-every:5",
+                         extra="in-flight=8 breaker-threshold=100")
+        got = p["out"].pop_all()
+        st = p["f"].stats.snapshot()
+        # frames 5,10,15,20 fail at completion
+        assert st["invoke_errors"] == 4
+        assert len(got) + st["frames_dropped"] + st["qos_dropped"] \
+            + st["shed"] == 20
+        assert len(got) == 16
+        rep = p["f"].transfer_report()
+        assert rep["errors"] == 4 and rep["completed"] == 16
+        assert rep["reorder"]["skipped"] == 4
+
+    def test_breaker_opens_and_sheds_with_frames_in_flight(self):
+        """Every completion fails: the breaker must open from the
+        completer thread's accounting and shed the backlog, with the
+        per-frame identity intact."""
+        p = _run_simlink(n=20, custom="rtt:5,svc:1,fail-every:1",
+                         extra="in-flight=4 breaker-threshold=3")
+        got = p["out"].pop_all()
+        st = p["f"].stats.snapshot()
+        assert got == []
+        assert st["breaker_opened"] >= 1
+        assert st["frames_dropped"] + st["qos_dropped"] + st["shed"] == 20
+        assert st["shed"] >= 1  # breaker OPEN shed at least one upfront
+
+    def test_dispatch_vs_completion_latency_split(self):
+        """The satellite fix: with a window, dispatch-to-return is the
+        cheap enqueue while dispatch-to-completion carries the link
+        RTT — the two metrics must be distinct and both surfaced."""
+        p = _run_simlink(custom="rtt:40,svc:1", extra="in-flight=8")
+        f = p["f"]
+        lat_us = f.latency_average_us()
+        disp_us = f.dispatch_average_us()
+        assert lat_us >= 40_000 * 0.9       # completion pays the RTT
+        assert disp_us < lat_us / 4         # dispatch does not
+        rep = f.transfer_report()
+        assert rep["window"] == 8
+        assert rep["in_flight_peak"] >= 2   # frames really overlapped
+
+    def test_sync_path_records_equal_latencies(self):
+        p = _run_simlink(custom="rtt:20,svc:1", extra="in-flight=1")
+        f = p["f"]
+        # no window: dispatch and completion are the same event
+        assert f.dispatch_average_us() == pytest.approx(
+            f.latency_average_us(), rel=0.01)
+        assert f.transfer_report() == {}
+
+
+# ------------------------------------------------------- trace integration
+
+class TestTraceTransferBlock:
+    def test_report_carries_window_and_coalesce_stats(self):
+        p = parse_launch(
+            f'tensortestsrc caps="{CAPS}" num-buffers=8 pattern=counter '
+            '! queue ! tensor_filter name=f framework=simlink '
+            'custom=rtt:20,svc:1 in-flight=4 ! appsink name=out')
+        p.fuse = False
+        tracer = p.enable_tracing()
+        p.run(timeout=60)
+        rep = tracer.report(p)
+        assert "transfer" in rep
+        win = rep["transfer"]["windows"]["f"]
+        assert win["window"] == 4
+        assert win["completed"] == 8
+        assert 0.0 < win["occupancy_avg"] <= 4.0
+
+
+# ---------------------------------------------------------- upload path
+
+class TestUploadCoalescing:
+    def test_uploads_coalesce_under_link_latency(self):
+        import jax
+        dev = jax.devices()[0]
+        transfer_stats(reset=True)
+        set_simulated_rtt_ms(40.0)
+        try:
+            pending = [submit_upload([np.full(4, i, np.float32)], dev)
+                       for i in range(6)]
+        finally:
+            # let queued RPCs finish against the slow link, then reset
+            from nnstreamer_tpu.tensors.transfer import resolve
+            outs = [[resolve(x) for x in batch] for batch in pending]
+            set_simulated_rtt_ms(0.0)
+        for i, batch in enumerate(outs):
+            assert isinstance(batch[0], jax.Array)
+            np.testing.assert_array_equal(np.asarray(batch[0]),
+                                          np.full(4, i, np.float32))
+        st = transfer_stats(reset=True)["upload"]
+        assert st["rpcs"] >= 1
+        # 6 uploads against a 40ms RTT: the ones queued behind the
+        # first RPC must share a later one
+        assert st["frames_per_rpc_avg"] > 1.0
+
+    def test_download_and_upload_accounted_separately(self):
+        import jax
+        from nnstreamer_tpu.tensors.transfer import resolve, submit_fetch
+        transfer_stats(reset=True)
+        dev = jax.devices()[0]
+        up = submit_upload([np.arange(8, dtype=np.float32)], dev)
+        arr = resolve(up[0])
+        down = submit_fetch([arr])
+        host = resolve(down[0])
+        np.testing.assert_array_equal(host, np.arange(8, dtype=np.float32))
+        st = transfer_stats(reset=True)
+        assert st["upload"]["frames"] >= 1
+        assert st["download"]["frames"] >= 1
+
+
+# ------------------------------------------------- racecheck (new roles)
+
+class TestRacecheckRoles:
+    def test_static_model_assigns_overlap_roles(self):
+        from pathlib import Path
+
+        import nnstreamer_tpu
+        from nnstreamer_tpu.analysis.concurrency.model import (
+            COMPLETER, DISPATCHER, UPLOADER, roles_of, scan_paths)
+        pkg = Path(nnstreamer_tpu.__file__).parent
+        model = scan_paths([str(pkg)])
+        ov = roles_of(model, "OverlapExecutor")
+        assert DISPATCHER in ov["submit"]
+        assert COMPLETER in ov["_complete_loop"]
+        tf = roles_of(model, "TensorFilter")
+        assert COMPLETER in tf["_complete_frame"]
+        up = roles_of(model, "_Uploader")
+        assert UPLOADER in up["_run"]
+
+    def test_runtime_lock_validator_over_overlap_roles(self):
+        """Drive a windowed simlink pipeline with the executor's and the
+        element's locks traced: the recorded acquisition graph must be
+        acyclic and a subset of the static racecheck graph."""
+        from pathlib import Path
+
+        import nnstreamer_tpu
+        from nnstreamer_tpu.analysis.concurrency import (
+            LockMonitor, analyze_paths, instrument_counters,
+            instrument_object)
+
+        p = parse_launch(
+            f'tensortestsrc caps="{CAPS}" num-buffers=10 pattern=counter '
+            '! queue ! tensor_filter name=f framework=simlink '
+            'custom=rtt:10,svc:1,fail-every:4 in-flight=4 '
+            'breaker-threshold=50 ! appsink name=out')
+        p.fuse = False
+        mon = LockMonitor()
+        p.start()
+        # the executor and breaker are built by start(): trace their
+        # locks before any frame flows
+        f = p["f"]
+        instrument_object(f._overlap, mon)           # OverlapExecutor._cv
+        instrument_object(f._overlap.window, mon)    # InFlightWindow._cv
+        instrument_object(f, mon)                    # TensorFilter._stats_lock
+        instrument_object(f._breaker, mon)           # CircuitBreaker._lock
+        instrument_counters(f.stats, mon)
+        p.wait_eos(timeout=60)
+        p.stop()
+        assert len(p["out"].pop_all()) == 8  # frames 4 and 8 fail
+        assert mon.acquisitions, "instrumented locks were never taken"
+        pkg = Path(nnstreamer_tpu.__file__).parent
+        static = analyze_paths([str(pkg)]).lock_edges
+        cycles, missed = mon.check_against_static(static)
+        assert cycles == [], \
+            f"runtime witnessed a deadlockable order: {cycles}"
+        assert missed == set(), f"static graph missed edges: {missed}"

@@ -142,6 +142,81 @@ def _run_once(desc: str, fuse: bool, timeout: float):
     return got, fused
 
 
+def _run_async(desc: str, k: int, timeout: float):
+    """Run UNFUSED with every synchronous tensor_filter forced to a
+    k-frame in-flight window (reorder on). k=1 is the sync twin.
+
+    Sinks are keyed by PARSE POSITION + kind, not by name:
+    auto-generated element names come from a process-global counter, so
+    the two runs of the same description would never share them."""
+    from nnstreamer_tpu.analysis.rules import kind_of
+    from nnstreamer_tpu.pipeline.element import SinkElement
+    from nnstreamer_tpu.pipeline.parser import parse_launch
+    pipe = parse_launch(desc)
+    pipe.fuse = False
+    for e in pipe.elements.values():
+        if kind_of(e) == "tensor_filter" \
+                and not getattr(e, "invoke_async", False):
+            e.set_property("in-flight", k)
+            e.set_property("reorder", True)
+    _bound_sources(pipe)
+    got = _capture_sinks(pipe)
+    keys = {name: f"#{i}:{kind_of(e)}" for i, (name, e) in enumerate(
+        (n, e) for n, e in pipe.elements.items()
+        if isinstance(e, SinkElement))}
+    pipe.run(timeout=timeout)
+    windowed = [e.name for e in pipe.elements.values()
+                if getattr(e, "_overlap", None) is not None]
+    return {keys[n]: recs for n, recs in got.items()}, windowed
+
+
+def check_async_parity(where: str, desc: str, k: int = 4,
+                       timeout: float = 60.0) -> Tuple[str, str]:
+    """-> (status, detail); status in {async-ok, no-filter, skipped,
+    FAIL}. Byte-compares the windowed (in-flight=k) run against the
+    sync (in-flight=1) run of the SAME unfused pipeline — the overlap
+    executor must be invisible in the output."""
+    from nnstreamer_tpu.analysis import analyze
+    from nnstreamer_tpu.analysis.rules import kind_of
+    from nnstreamer_tpu.pipeline.parser import parse_launch
+    try:
+        probe = parse_launch(desc)
+    except ValueError as exc:
+        return "skipped", f"not a pipeline: {exc}"
+    reason = _runnable(probe)
+    if reason is not None:
+        return "skipped", reason
+    filts = [e for e in probe.elements.values()
+             if kind_of(e) == "tensor_filter"
+             and not getattr(e, "invoke_async", False)]
+    if not filts:
+        return "no-filter", "no synchronous tensor_filter to window"
+    if analyze(probe).errors:
+        return "skipped", "pipelint rejects it (validation gate)"
+    try:
+        sync_out, _ = _run_async(desc, 1, timeout=timeout)
+    except Exception as exc:  # noqa: BLE001
+        # the pipeline can't run even WITHOUT a window (needs devices,
+        # un-runnable caps, ...): not an async defect, no coverage
+        return "skipped", f"baseline (sync) run crashed: {exc!r}"
+    try:
+        async_out, windowed = _run_async(desc, k, timeout=timeout)
+    except Exception as exc:  # noqa: BLE001
+        return "FAIL", f"windowed run crashed: {exc!r}"
+    if not windowed:
+        # backend degraded to sync (no dispatch support): parity is
+        # vacuous for this pipeline, don't count it as coverage
+        return "no-filter", "no filter backend took the in-flight window"
+    for sink in sync_out:
+        if async_out.get(sink) != sync_out[sink]:
+            na, nb = len(async_out.get(sink, [])), len(sync_out[sink])
+            return "FAIL", (f"sink {sink!r}: windowed bytes differ from "
+                            f"the sync path ({na} vs {nb} buffers)")
+    nbuf = sum(len(v) for v in sync_out.values())
+    return "async-ok", (f"window={k} on {len(windowed)} filter(s), "
+                        f"{nbuf} buffers identical")
+
+
 def check_parity(where: str, desc: str, timeout: float = 60.0
                  ) -> Tuple[str, str]:
     """-> (status, detail); status in {fused-ok, unfused, skipped, FAIL}."""
@@ -184,31 +259,47 @@ def main(argv=None) -> int:
                     "tests/*.py and README.md)")
     ap.add_argument("-v", "--verbose", action="store_true")
     ap.add_argument("--timeout", type=float, default=60.0)
+    ap.add_argument("--mode", choices=("fuse", "async"), default="fuse",
+                    help="fuse: fused-vs-chain parity (default); async: "
+                    "windowed-vs-sync parity over the same corpus")
+    ap.add_argument("--window", type=int, default=4,
+                    help="in-flight window for --mode async (default 4)")
     opts = ap.parse_args(argv)
 
     paths = ([Path(p) for p in opts.paths] if opts.paths else
              sorted(ROOT.glob("tests/*.py")) + [ROOT / "README.md"])
     candidates = BUILTIN + collect(paths)
 
-    counts = {"fused-ok": 0, "unfused": 0, "skipped": 0, "FAIL": 0}
+    if opts.mode == "async":
+        ok_key, none_key = "async-ok", "no-filter"
+        counts = {"async-ok": 0, "no-filter": 0, "skipped": 0, "FAIL": 0}
+    else:
+        ok_key, none_key = "fused-ok", "unfused"
+        counts = {"fused-ok": 0, "unfused": 0, "skipped": 0, "FAIL": 0}
     failures: List[str] = []
     seen = set()
     for where, desc in candidates:
         if desc in seen:
             continue
         seen.add(desc)
-        status, detail = check_parity(where, desc, timeout=opts.timeout)
+        if opts.mode == "async":
+            status, detail = check_async_parity(
+                where, desc, k=opts.window, timeout=opts.timeout)
+        else:
+            status, detail = check_parity(where, desc,
+                                          timeout=opts.timeout)
         counts[status] += 1
         if status == "FAIL":
             failures.append(f"{where}: {detail}\n    {desc}")
         if opts.verbose or status == "FAIL":
             print(f"[{status}] {where}: {detail}")
-    print(f"fuse-parity: {counts['fused-ok']} pipelines byte-identical, "
-          f"{counts['unfused']} had nothing to fuse, "
+    verb = "window" if opts.mode == "async" else "fuse"
+    print(f"{opts.mode}-parity: {counts[ok_key]} pipelines "
+          f"byte-identical, {counts[none_key]} had nothing to {verb}, "
           f"{counts['skipped']} skipped, {counts['FAIL']} failures")
-    if counts["fused-ok"] == 0:
-        print("fuse-parity: BUILTIN suite did not fuse — the gate is "
-              "vacuous", file=sys.stderr)
+    if counts[ok_key] == 0:
+        print(f"{opts.mode}-parity: BUILTIN suite yielded no coverage — "
+              "the gate is vacuous", file=sys.stderr)
         return 1
     return 1 if failures else 0
 
