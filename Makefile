@@ -10,7 +10,7 @@ LIB := $(BUILD)/libnnstpu.so
 EXAMPLES := $(BUILD)/custom_passthrough.so $(BUILD)/custom_scaler.so
 
 .PHONY: native clean test check tier1 lint racecheck chaos chaos-zeroloss \
-	chaos-fleet fuse-parity async-parity package
+	chaos-fleet chaos-preempt fuse-parity async-parity package
 
 native: $(LIB) $(EXAMPLES)
 
@@ -25,6 +25,7 @@ check: native lint racecheck
 	$(MAKE) async-parity
 	$(MAKE) chaos
 	$(MAKE) chaos-fleet
+	$(MAKE) chaos-preempt
 
 # `make fuse-parity` = the fusion compiler's byte-parity oracle: every
 # fusible pipeline in the corpus (plus a built-in representative suite)
@@ -60,6 +61,15 @@ chaos-zeroloss:
 # with zero declared losses and zero stream aborts.
 chaos-fleet:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_router.py -q -m slow
+
+# `make chaos-preempt` = the preemption acceptance run (slow-marked,
+# excluded from tier-1): kill -TERM a training process mid-run and a
+# fleet replica mid-serving — the trainer must resume at the exact
+# recorded epoch (no repeated or skipped optimizer updates) and the
+# resurrected replica must rejoin with the router's ledger balancing
+# exactly.
+chaos-preempt:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py -q -m slow
 
 # `make tier1` = the exact ROADMAP.md tier-1 verify gate, verbatim
 # (timeout, log tee, pass-dot count and all).

@@ -36,6 +36,7 @@ ride in "extras" with fps and p50 steady-state frame time per config.
 from __future__ import annotations
 
 import json
+import os
 import statistics
 import sys
 import threading
@@ -1194,6 +1195,68 @@ LLM_TOY = "zoo://gpt?vocab=8192&d_model=512&n_heads=8&n_layers=8"
 LLM_LARGE = "zoo://gpt?vocab=32000&d_model=1536&n_heads=16&n_layers=24"
 
 
+_SUMMARY_BUDGET = 1500  # bytes; the driver truncates longer stdout lines
+
+# compact-summary scalar keys, in DROP order (last dropped first) when
+# the line overflows the budget
+_SUMMARY_SCALARS = (
+    "headline_verdict", "headline_median_fps", "headline_link_ceiling_fps",
+    "headline_weather_limited", "buffers_per_rtt", "depth_proven",
+    "matmul_tflops_measured", "matmul_mfu_pct", "mobilenet_mfu_pct",
+    "fused_vs_unfused_pct", "pipeline_vs_invoke_pct",
+    "pipeline_top1_vs_invoke_pct", "serve_batched_fps",
+    "wire_bytes_reduction_pct", "llm_decode_tok_s",
+    "llm_large_decode_tok_s", "llm_large_mbu_pct")
+
+
+def _compact_summary(result: dict) -> str:
+    """The final stdout line: full shape of the detail JSON but <= 1.5 KB
+    so the result parser never sees a truncated (-> null) record. The
+    complete record lives in BENCH_DETAIL.json next to this script."""
+    ex = result.get("extras") or {}
+    configs = {name: {"fps": row.get("fps"),
+                      "weather_limited": row.get("weather_limited")}
+               for name, row in (ex.get("configs") or {}).items()}
+    top1 = (ex.get("configs") or {}).get("devres_top1_batch32") or {}
+    cex = {k: ex[k] for k in _SUMMARY_SCALARS if k in ex}
+    for k in ("buffers_per_rtt", "depth_proven"):
+        if k in top1:
+            cex[k] = top1[k]
+    for k in ("chaos_zeroloss", "fleet_failover", "async_overlap"):
+        if isinstance(ex.get(k), dict):
+            cex[f"{k}_verdict"] = ex[k].get("verdict")
+    cex["configs"] = configs
+    cex["detail"] = "BENCH_DETAIL.json"
+    summary = {"metric": result["metric"], "value": result["value"],
+               "unit": result["unit"], "vs_baseline": result["vs_baseline"],
+               "extras": cex}
+    drop = [k for k in _SUMMARY_SCALARS if k in cex][::-1]
+    line = json.dumps(summary, separators=(",", ":"))
+    while len(line.encode()) > _SUMMARY_BUDGET:
+        if drop:
+            cex.pop(drop.pop(0), None)
+        elif configs:
+            configs.popitem()
+        else:
+            break
+        line = json.dumps(summary, separators=(",", ":"))
+    return line
+
+
+def _emit(result: dict) -> None:
+    """Full detail to BENCH_DETAIL.json, compact summary (the machine-
+    parsed record) as the FINAL stdout line."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_DETAIL.json")
+    try:
+        with open(path, "w") as f:
+            json.dump(result, f, indent=1)
+            f.write("\n")
+    except OSError as e:  # noqa: PERF203 — detail is best-effort
+        print(f"# BENCH_DETAIL.json write failed: {e}", file=sys.stderr)
+    print(_compact_summary(result))
+
+
 def main() -> int:
     extras = {}
     configs = {}
@@ -1302,6 +1365,14 @@ def main() -> int:
         extras["devres_top1_batch32_fps"] = row1["fps"]
         extras["pipeline_top1_vs_invoke_pct"] = round(
             100.0 * row1["fps"] / inv32, 1)
+        # dispatch-depth proof (VERDICT item 5): sustained buffers in
+        # flight per link round trip. >= 4 means the pipeline keeps the
+        # link pipe full instead of one-at-a-time request/reply
+        # (reference: 5.9 on the seed's weather).
+        if row1.get("rtt_ms"):
+            bpr = row1["fps"] / 32.0 * (row1["rtt_ms"] / 1e3)
+            row1["buffers_per_rtt"] = round(bpr, 2)
+            row1["depth_proven"] = bool(bpr >= 4.0)
     except Exception as e:  # noqa: BLE001
         print(f"# devres pipeline failed: {e}", file=sys.stderr)
 
@@ -1477,12 +1548,17 @@ def main() -> int:
     # per-config adjudication is most valuable exactly then
     extras["configs"] = configs
     if not attempts:
-        print(json.dumps({"metric": "mobilenet_v2_pipeline_fps",
-                          "value": None, "unit": "fps",
-                          "vs_baseline": None, "extras": extras}))
+        _emit({"metric": "mobilenet_v2_pipeline_fps",
+               "value": None, "unit": "fps",
+               "vs_baseline": None, "extras": extras})
         return 1
     best_att = max(attempts, key=lambda a: a["fps"])
     extras["headline_attempts"] = attempts
+    # best-of-N is the headline (the baseline is a best-case bar), but
+    # the median rides along so a single lucky weather window is
+    # readable as such (ADVICE item 4)
+    extras["headline_median_fps"] = round(
+        statistics.median(a["fps"] for a in attempts), 2)
     extras["headline_link_ceiling_fps"] = best_att["link_ceiling_fps"]
     extras["headline_weather_limited"] = best_att["weather_limited"]
     # the one-line verdict a round-over-round diff needs: beaten,
@@ -1498,13 +1574,13 @@ def main() -> int:
         extras["headline_verdict"] = "missed"
     extras["mobilenet_v2_p50_frame_us"] = best_att["p50_frame_us"]
 
-    print(json.dumps({
+    _emit({
         "metric": "mobilenet_v2_pipeline_fps",
         "value": round(best_att["fps"], 2),
         "unit": "fps",
         "vs_baseline": round(best_att["fps"] / BASELINE_FPS, 3),
         "extras": extras,
-    }))
+    })
     return 0
 
 

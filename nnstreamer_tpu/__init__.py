@@ -19,6 +19,8 @@ from .filters import register_custom_easy
 from .single import SingleShot
 from .fault import (CircuitBreaker, ErrorPolicy, FaultInjected,
                     TransientError, register_fatal, register_transient)
+from .checkpoint import (PreemptGuard, SnapshotError, SnapshotStore,
+                         install_sigterm)
 
 __all__ = [
     "Buffer", "Chunk", "Caps", "TensorInfo", "TensorsInfo", "TensorsConfig",
@@ -26,4 +28,5 @@ __all__ = [
     "register_element", "register_custom_easy", "SingleShot", "__version__",
     "CircuitBreaker", "ErrorPolicy", "FaultInjected", "TransientError",
     "register_fatal", "register_transient",
+    "SnapshotStore", "SnapshotError", "PreemptGuard", "install_sigterm",
 ]

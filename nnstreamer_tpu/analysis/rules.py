@@ -708,6 +708,37 @@ class AsyncWindowRule(Rule):
         return None
 
 
+class StatefulNoCheckpointRule(Rule):
+    """An element that declares itself NOT restart-safe carries state a
+    plain stop/start loses — exactly the state a preemption
+    (``Pipeline.preempt``/SIGTERM) needs to snapshot. If it also does
+    not implement ``snapshot_state``, a preempted pipeline silently
+    discards that state on restore: frames, windows, or training
+    progress vanish without a declaration. WARN, not ERROR — the
+    pipeline still runs, it just cannot survive preemption whole."""
+
+    id = "stateful-no-checkpoint"
+    severity = Severity.WARNING
+
+    def check(self, ctx: LintContext):
+        from ..pipeline.element import Element as _Base
+        for e in ctx.elements:
+            cls = type(e)
+            # only elements that EXPLICITLY declare RESTART_SAFE=False
+            # on their own class (inherited defaults are the base
+            # contract, not a statement about this element's state)
+            if "RESTART_SAFE" not in cls.__dict__ \
+                    or cls.RESTART_SAFE is not False:
+                continue
+            if cls.snapshot_state is _Base.snapshot_state:
+                yield self.finding(
+                    f"{kind_of(e)} declares RESTART_SAFE=False but "
+                    f"implements no snapshot_state(): its state is "
+                    f"silently lost across preempt/restore; implement "
+                    f"the Checkpointable hooks or declare why the state "
+                    f"is disposable", e.name)
+
+
 ALL_RULES: List[Rule] = [
     DanglingPadRule(), CycleRule(), TeeNoQueueRule(), JitSignatureRule(),
     ShardingRule(), SinklessBranchRule(), CombinerDtypeRule(),
@@ -715,7 +746,7 @@ ALL_RULES: List[Rule] = [
     WireConfigRule(), FusionBreakRule(), FusionTransferRule(),
     SessionReplayBudgetRule(), SessionNoReconnectRule(),
     RouterNoReplicasRule(), RouterAffinitySessionlessRule(),
-    AsyncWindowRule(),
+    AsyncWindowRule(), StatefulNoCheckpointRule(),
 ]
 
 

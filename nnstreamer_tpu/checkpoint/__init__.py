@@ -1,0 +1,27 @@
+"""Pipeline-wide checkpoint/restore: crash-consistent snapshots of
+every stateful element, SIGTERM drain-and-snapshot, and resume.
+
+Three pieces (see ``Documentation/robustness.md`` — "surviving
+preemption"):
+
+- the ``Checkpointable`` element contract
+  (``Element.snapshot_state()/restore_state()``, advertised by the
+  ``CHECKPOINTABLE`` doc attribute) implemented by every stateful
+  element — trainer, aggregator, repo, LLM continuous batching, serve
+  scheduler ledger, edge session rings;
+- :class:`SnapshotStore` — write-temp + hashed manifest + atomic
+  rename + retain-N GC; :meth:`~SnapshotStore.verify` rejects a
+  truncated or tampered snapshot with a :class:`SnapshotError` naming
+  the bad blob;
+- the preemption path — ``Pipeline.preempt(grace_s, dir)`` (quiesce →
+  bounded drain → snapshot → stop, degrading to snapshot-without-drain
+  under a short grace with abandoned frames *declared*), wired to
+  SIGTERM by :class:`~nnstreamer_tpu.fault.preempt.PreemptGuard`, and
+  ``Pipeline.restore(dir)`` rebuilding element state before
+  ``start()``.
+"""
+from ..fault.preempt import PreemptGuard, install_sigterm
+from .store import MANIFEST, SnapshotError, SnapshotStore
+
+__all__ = ["SnapshotStore", "SnapshotError", "MANIFEST",
+           "PreemptGuard", "install_sigterm"]

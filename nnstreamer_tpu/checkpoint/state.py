@@ -1,0 +1,37 @@
+"""Picklable state helpers shared by element snapshot hooks.
+
+A :class:`~nnstreamer_tpu.tensors.buffer.Buffer` may hold
+device-resident ``jax.Array`` chunks and in-flight D2H fetches —
+neither pickles. :func:`dump_buffer` materializes every chunk to a
+host ndarray and keeps only the picklable frame metadata;
+:func:`load_buffer` rebuilds an equivalent host-resident buffer (a
+restored frame re-enters the pipeline like any converter output and
+migrates back to device on first use).
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..tensors.buffer import Buffer, BufferFlags, Chunk
+
+
+def dump_buffer(buf: Buffer) -> Dict:
+    return {"arrays": [c.host() for c in buf.chunks],
+            "pts": buf.pts, "dts": buf.dts, "duration": buf.duration,
+            "flags": int(buf.flags), "extras": dict(buf.extras)}
+
+
+def load_buffer(d: Dict) -> Buffer:
+    buf = Buffer([Chunk(a) for a in d["arrays"]], pts=d.get("pts"),
+                 dts=d.get("dts"), duration=d.get("duration"),
+                 flags=BufferFlags(int(d.get("flags", 0))))
+    buf.extras = dict(d.get("extras") or {})
+    return buf
+
+
+def dump_buffers(bufs) -> List[Dict]:
+    return [dump_buffer(b) for b in bufs]
+
+
+def load_buffers(dumps) -> List[Buffer]:
+    return [load_buffer(d) for d in dumps]

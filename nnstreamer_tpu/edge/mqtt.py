@@ -29,7 +29,11 @@ class MqttBroker:
     qos1 semantics (clean-session, like mosquitto with persistence off):
     inbound qos1 PUBLISHes are PUBACKed; fan-out rides each
     subscription's granted qos (min(published, subscribed)), with a
-    per-subscriber packet id and the subscriber's PUBACKs consumed."""
+    per-subscriber packet id and the subscriber's PUBACKs consumed.
+    Outbound qos1 fan-out is send-once: the broker does not retransmit
+    to a subscriber that never PUBACKs (publisher-side redelivery plus
+    the subscriber's reconnect-and-resubscribe cover the at-least-once
+    contract end to end)."""
 
     def __init__(self, host: str = "localhost", port: int = 0):
         self._listener = TcpListener(host, port, self._conn_loop,

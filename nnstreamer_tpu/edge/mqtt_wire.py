@@ -270,11 +270,15 @@ class MqttClient:
         # multi-MB tensor PUBLISH interleaved with an ack wait must not
         # be torn mid-body, or the stream desyncs permanently)
         self._rxbuf = bytearray()
-        # qos1 publishes awaiting PUBACK: pid -> (topic, payload). On a
-        # dead connection these survive for take_unacked()/redeliver()
-        # on a fresh client — the at-least-once reconnect story (≙ Paho
-        # MQTTAsync redelivery, which the reference's mqttsink rides)
+        # qos1 publishes awaiting PUBACK: pid -> (seq, topic, payload).
+        # On a dead connection these survive for take_unacked()/
+        # redeliver() on a fresh client — the at-least-once reconnect
+        # story (≙ Paho MQTTAsync redelivery, which the reference's
+        # mqttsink rides). seq is a monotonic send counter: packet ids
+        # wrap at 16 bits, so sorting by pid would misorder a drain
+        # that straddles the wrap
         self._unacked: dict = {}
+        self._send_seq = 0
         try:
             self._sock.sendall(connect_packet(client_id, keepalive))
             ptype, _, body = self._read_packet()
@@ -398,7 +402,8 @@ class MqttClient:
             return
         self._packet_id = (self._packet_id % 0xFFFF) + 1
         pid = self._packet_id
-        self._unacked[pid] = (topic, payload)
+        self._send_seq += 1
+        self._unacked[pid] = (self._send_seq, topic, payload)
         self._publish_qos1(pid, topic, payload, dup=False)
 
     def _publish_qos1(self, pid: int, topic: str, payload: bytes,
@@ -443,19 +448,24 @@ class MqttClient:
     def take_unacked(self) -> List[Tuple[str, bytes]]:
         """Drain the qos1 messages this client could not confirm, in
         send order — feed them to :meth:`redeliver` on a fresh client
-        after a reconnect."""
-        out = [self._unacked[k] for k in sorted(self._unacked)]
+        after a reconnect. Ordered by the monotonic send sequence, NOT
+        by packet id: pids wrap at 16 bits, and a drain straddling the
+        wrap would otherwise replay new-before-old."""
+        out = [(t, p) for _seq, t, p in
+               sorted(self._unacked.values(), key=lambda v: v[0])]
         self._unacked.clear()
         return out
 
     def redeliver(self, messages: List[Tuple[str, bytes]]) -> None:
         """Republish messages taken from a dead client's
         :meth:`take_unacked`, DUP-flagged from the first transmission
-        (the receiver may already own them — at-least-once)."""
+        (the receiver may already own them — at-least-once). Fresh
+        sequence numbers: redelivery order IS the new send order."""
         for topic, payload in messages:
             self._packet_id = (self._packet_id % 0xFFFF) + 1
             pid = self._packet_id
-            self._unacked[pid] = (topic, payload)
+            self._send_seq += 1
+            self._unacked[pid] = (self._send_seq, topic, payload)
             self._publish_qos1(pid, topic, payload, dup=True)
 
     def ping(self) -> None:

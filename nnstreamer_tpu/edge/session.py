@@ -179,6 +179,28 @@ class ReplayRing:
             return ([(s, b) for s, (b, _nb) in self._frames.items()
                      if s >= frm], lost)
 
+    # -- checkpoint/restore (checkpoint/) ----------------------------------
+    def dump(self) -> Tuple[List[Tuple[int, object]], int]:
+        """Coherent (retained frames, evicted_through) view for the
+        preemption snapshot — unacked frames survive process death so a
+        resumed subscriber still gets its gap replay."""
+        with self._lock:
+            return ([(s, b) for s, (b, _nb) in self._frames.items()],
+                    self.evicted_through)
+
+    def load(self, frames: List[Tuple[int, object]],
+             evicted_through: int) -> None:
+        """Rebuild from :meth:`dump` output (restore-before-start: no
+        concurrent appenders yet, but take the lock anyway)."""
+        with self._lock:
+            self._frames.clear()
+            self._bytes = 0
+            for seq, buf in frames:
+                nb = int(getattr(buf, "nbytes", 0))
+                self._frames[seq] = (buf, nb)
+                self._bytes += nb
+            self.evicted_through = int(evicted_through)
+
 
 class SessionReceiver:
     """Receiver-side session state: a cumulative delivery watermark,

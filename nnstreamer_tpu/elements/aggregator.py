@@ -24,10 +24,21 @@ class TensorAggregator(TransformElement):
     PROPS = {"frames-in": 1, "frames-out": 1, "frames-flush": 0,
              "frames-dim": 3, "concat": True, "silent": True}
     RESTART_SAFE = False  # a restart would drop the aggregation window
+    CHECKPOINTABLE = "the partial aggregation window (frames + timing)"
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self._window: Deque[Buffer] = collections.deque()
+
+    def snapshot_state(self, snap_dir):
+        if not self._window:
+            return None
+        from ..checkpoint.state import dump_buffers
+        return {"window": dump_buffers(self._window)}
+
+    def restore_state(self, state, snap_dir):
+        from ..checkpoint.state import load_buffers
+        self._window = collections.deque(load_buffers(state["window"]))  # racecheck: ok(restore runs before start(): no chain thread exists yet)
 
     def _np_axis(self, ndim: int) -> int:
         ref_dim = int(self.frames_dim)

@@ -164,6 +164,9 @@ class TensorFilter(Element):
         self._throttle_period_ns = 0       # from downstream QoS events
         self._next_accept_ts: Optional[int] = None
         self._breaker = None
+        # checkpoint/: framework state recovered by restore_state,
+        # applied once the framework is open (start())
+        self._fw_restore = None
         self.stats.update({"invoke_errors": 0, "frames_dropped": 0,
                            "qos_dropped": 0, "shed": 0,
                            "breaker_opened": 0})
@@ -227,6 +230,11 @@ class TensorFilter(Element):
     def start(self) -> None:
         super().start()
         self._open_fw()
+        if self._fw_restore is not None:
+            state, snap_dir = self._fw_restore
+            if hasattr(self.fw, "restore_state"):
+                self.fw.restore_state(state, snap_dir)
+            self._fw_restore = None
         self._start_time = time.monotonic()
         if int(self.breaker_threshold) > 0:
             from ..fault.breaker import CircuitBreaker
@@ -270,6 +278,23 @@ class TensorFilter(Element):
             self._overlap.flush()
         if self._watchdog is not None:
             self._watchdog.quiesce()
+
+    # -- checkpoint/restore (checkpoint/) ---------------------------------
+    CHECKPOINTABLE = ("whatever the loaded framework exposes (e.g. the "
+                      "llm backend's continuous-batching streams)")
+
+    def snapshot_state(self, snap_dir):
+        # delegation, not ownership: the element is stateless between
+        # frames, but a framework may carry cross-invoke state (llm
+        # continuous batching) it knows how to snapshot
+        if self.fw is not None and hasattr(self.fw, "snapshot_state"):
+            return self.fw.snapshot_state(snap_dir)
+        if self._fw_restore is not None:
+            return self._fw_restore[0]  # restored, never started: re-emit
+        return None
+
+    def restore_state(self, state, snap_dir):
+        self._fw_restore = (state, snap_dir)
 
     def stop(self) -> None:
         super().stop()

@@ -195,6 +195,27 @@ class TensorRate(TransformElement):
         n, _, d = self.framerate.partition("/")
         return int(n), int(d or 1)
 
+    # -- checkpoint/restore (checkpoint/) ---------------------------------
+    CHECKPOINTABLE = "the PTS schedule (next emit slot + gap-fill frame)"
+
+    def snapshot_state(self, snap_dir):
+        if self._next_ts is None and self._prev is None:
+            return None
+        from ..checkpoint.state import dump_buffer
+        return {"next_ts": self._next_ts,
+                "last_in_pts": self._last_in_pts,
+                "throttling": self._throttling,
+                "prev": dump_buffer(self._prev)
+                if self._prev is not None else None}
+
+    def restore_state(self, state, snap_dir):
+        from ..checkpoint.state import load_buffer
+        self._next_ts = state["next_ts"]  # racecheck: ok(restore runs before start(): no chain thread exists yet)
+        self._last_in_pts = state["last_in_pts"]  # racecheck: ok(restore runs before start())
+        self._throttling = bool(state["throttling"])  # racecheck: ok(restore runs before start())
+        self._prev = (load_buffer(state["prev"])  # racecheck: ok(restore runs before start())
+                      if state.get("prev") is not None else None)
+
     def handle_event(self, pad, event) -> None:
         from ..pipeline.events import FlushEvent, SegmentEvent
         if isinstance(event, (SegmentEvent, FlushEvent)):

@@ -93,6 +93,17 @@ class MqttSink(SinkElement):
 
     def stop(self) -> None:
         if self._q1_backlog:
+            # last best-effort flush: a backlog held through a broker
+            # outage gets one final shot (skipping the backoff gate)
+            # before the held frames are declared — a failure here must
+            # still reach close(), never leak the client
+            self._next_reconnect = 0.0
+            try:
+                self._flush_qos1()
+            except Exception:  # noqa: BLE001 — stop() must complete
+                logger.warning("%s: final qos1 flush failed",
+                               self.name, exc_info=True)
+        if self._q1_backlog:
             logger.warning("%s: stopping with %d unconfirmed qos1 "
                            "frame(s)", self.name, len(self._q1_backlog))
         if self._client is not None:

@@ -69,6 +69,20 @@ class TensorRepo:
         with self._lock:
             self._slots.clear()
 
+    def snapshot_slot(self, index: int):
+        """Coherent (queued buffers, eos) view of one slot for the
+        checkpoint path (tensor_reposink's snapshot_state)."""
+        s = self.slot(index)
+        with s.cond:
+            return list(s.queue), s.eos
+
+    def restore_slot(self, index: int, bufs, eos: bool) -> None:
+        s = self.slot(index)
+        with s.cond:
+            s.queue = collections.deque(bufs)
+            s.eos = bool(eos)
+            s.cond.notify_all()
+
 
 GLOBAL_REPO = TensorRepo()
 
@@ -76,6 +90,8 @@ GLOBAL_REPO = TensorRepo()
 @register_element("tensor_reposink")
 class TensorRepoSink(SinkElement):
     PROPS = {"slot-index": 0, "silent": True}
+    # the writer owns the slot: one snapshot/restore site per cycle
+    CHECKPOINTABLE = "the repo slot's queued frames + EOS flag"
 
     def render(self, buf: Buffer) -> None:
         GLOBAL_REPO.push(self.slot_index, buf)
@@ -83,6 +99,19 @@ class TensorRepoSink(SinkElement):
     def on_eos(self) -> None:
         GLOBAL_REPO.set_eos(self.slot_index)
         super().on_eos()
+
+    def snapshot_state(self, snap_dir):
+        from ..checkpoint.state import dump_buffers
+        bufs, eos = GLOBAL_REPO.snapshot_slot(self.slot_index)
+        if not bufs and not eos:
+            return None
+        return {"queue": dump_buffers(bufs), "eos": eos}
+
+    def restore_state(self, state, snap_dir):
+        from ..checkpoint.state import load_buffers
+        GLOBAL_REPO.restore_slot(self.slot_index,
+                                 load_buffers(state["queue"]),
+                                 state.get("eos", False))
 
 
 @register_element("tensor_reposrc")

@@ -28,6 +28,8 @@ class TensorTrainer(TransformElement):
     SINK_TEMPLATES = {"sink": "other/tensors"}
     SRC_TEMPLATES = {"src": "other/tensors"}
     RESTART_SAFE = False  # a restart would lose optimizer/step state
+    CHECKPOINTABLE = ("completed-epoch counter + params (orbax) + "
+                      "optimizer moments")
     PROPS = {
         "framework": "jax",
         "model-config": "",
@@ -46,6 +48,7 @@ class TensorTrainer(TransformElement):
         super().__init__(name, **props)
         self.fw = None
         self._pushed = 0
+        self._restore = None  # (state, snap_dir) stashed until start()
 
     def start(self) -> None:
         super().start()
@@ -62,6 +65,10 @@ class TensorTrainer(TransformElement):
                 epochs=self.epochs,
                 mesh=self.mesh,
                 rules=self.rules))
+            if self._restore is not None and hasattr(self.fw, "resume_from"):
+                state, snap_dir = self._restore
+                self.fw.resume_from(state, snap_dir)
+                self._restore = None
             self.fw.set_event_notifier(self._on_trainer_event)
             self.fw.start()
 
@@ -109,3 +116,32 @@ class TensorTrainer(TransformElement):
             self.fw.end_of_data()  # stop waiting on the sample queue
         if self.fw is not None and hasattr(self.fw, "wait_training_complete"):
             self.fw.wait_training_complete(timeout=600.0)
+
+    # -- checkpoint/restore (checkpoint/) ----------------------------------
+    def preempt(self) -> None:
+        """Preemption pauses training at the step boundary; a regular
+        drain must keep FINISHING the remaining epochs (on_eos waits for
+        completion), so the default drain-delegating hook is wrong
+        here."""
+        if self.fw is not None and hasattr(self.fw, "pause"):
+            self.fw.pause()
+
+    def snapshot_state(self, snap_dir):
+        if self.fw is None:
+            # snapshotting a restored-but-never-started pipeline:
+            # preserve the stashed state (and its params files) rather
+            # than dropping it
+            if self._restore is not None:
+                import os
+                import shutil
+                state, old_dir = self._restore
+                if os.path.isdir(old_dir):
+                    shutil.copytree(old_dir, snap_dir, dirs_exist_ok=True)
+                return state
+            return None
+        if hasattr(self.fw, "snapshot"):
+            return self.fw.snapshot(snap_dir)
+        return None
+
+    def restore_state(self, state, snap_dir):
+        self._restore = (state, snap_dir)

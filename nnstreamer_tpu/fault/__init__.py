@@ -11,6 +11,7 @@ from .errors import (FaultInjected, TransientError, is_transient,
                      register_fatal, register_transient)
 from .policy import ErrorPolicy, handle_chain_error, policy_of, \
     restart_element
+from .preempt import PreemptGuard, install_sigterm
 from .supervisor import CONTINUE, ESCALATE, RESTART, Supervisor
 
 __all__ = [
@@ -19,5 +20,6 @@ __all__ = [
     "TransientError", "FaultInjected", "is_transient",
     "register_transient", "register_fatal",
     "ErrorPolicy", "policy_of", "handle_chain_error", "restart_element",
+    "PreemptGuard", "install_sigterm",
     "Supervisor", "CONTINUE", "RESTART", "ESCALATE",
 ]

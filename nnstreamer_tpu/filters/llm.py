@@ -56,6 +56,12 @@ class LlmFilter(FilterFramework):
         self._pending: List[tuple] = []
         self._cond = threading.Condition()
         self._sched: Optional[threading.Thread] = None
+        # checkpoint/: the live slot table (published by _sched_body,
+        # stream bookkeeping mutated under _cond) and the stream state
+        # recovered from a preemption snapshot, adopted on prompt match
+        # at the next invoke_async (see snapshot_state/restore_state)
+        self._streams: Optional[List[Optional[Dict[str, Any]]]] = None
+        self._recovered: Optional[Dict[str, Any]] = None
 
     def open(self, props: FilterProperties) -> None:
         import jax
@@ -312,7 +318,15 @@ class LlmFilter(FilterFramework):
             self._check_prompt(flat, int(self._opts.get(
                 "max_len", str(DEFAULT_BATCH_MAX_LEN))))
             with self._cond:
-                self._pending.append((flat, ctx))
+                rem = None
+                if self._recovered is not None:
+                    # resurrection: a re-submitted prompt that matches a
+                    # snapshotted stream continues where it stopped —
+                    # the emitted tokens (already delivered through the
+                    # acked session pre-crash) join the prefill context
+                    # and only the undelivered remainder is generated
+                    rem, flat = self._adopt_recovered_locked(flat)
+                self._pending.append((flat, ctx, rem))
                 self._cond.notify_all()
                 # start-check under the lock: two racing invokes must not
                 # spawn two schedulers splitting one slot pool
@@ -333,6 +347,55 @@ class LlmFilter(FilterFramework):
         t = threading.Thread(target=run, name="llm-generate", daemon=True)
         self._threads.append(t)
         t.start()
+
+    # -- checkpoint/restore (checkpoint/) ----------------------------------
+    def snapshot_state(self, snap_dir) -> Optional[Dict[str, Any]]:
+        """Continuous-batching state for a preemption snapshot: per
+        stream (queued or mid-generation) the prompt, the tokens already
+        emitted, and the remaining budget. The KV cache itself is NOT
+        saved — it is recomputed by one prefill over prompt+emitted at
+        adoption time (cheaper and version-proof next to dumping a
+        device cache). Single-stream mode (n_parallel=1) keeps no
+        scheduler state and snapshots nothing."""
+        with self._cond:
+            pend = [{"prompt": np.asarray(p, np.int32).tolist(),
+                     "emitted": [], "remaining": rem}
+                    for (p, _ctx, rem) in self._pending]
+            act = [{"prompt": s["prompt"].tolist(),
+                    "emitted": list(s["emitted"]),
+                    "remaining": int(s["remaining"])}
+                   for s in (self._streams or [])
+                   if s is not None and s["remaining"] > 0]
+        if not pend and not act:
+            return None
+        return {"streams": act + pend}
+
+    def restore_state(self, state, snap_dir) -> None:
+        """Stash recovered streams; they are adopted lazily when a
+        re-submitted prompt (the client's RESUME-driven resend) matches
+        one of them — see invoke_async."""
+        with self._cond:
+            self._recovered = state
+
+    def _adopt_recovered_locked(self, flat: np.ndarray):
+        """Match an incoming prompt against the recovered streams
+        (caller holds _cond). On a hit: continuation — the pre-crash
+        prompt + already-emitted tokens become the prefill context and
+        only the remaining budget is generated. Returns
+        (remaining_override, prompt_to_queue)."""
+        entries = self._recovered.get("streams") or []
+        for i, ent in enumerate(entries):
+            if np.array_equal(np.asarray(ent["prompt"], np.int32), flat):
+                entries.pop(i)
+                if not entries:
+                    self._recovered = None
+                emitted = np.asarray(ent.get("emitted") or [], np.int32)
+                rem = ent.get("remaining")
+                if emitted.size:
+                    flat = np.concatenate(
+                        [flat.astype(np.int32), emitted])
+                return rem, flat
+        return None, flat
 
     # -- continuous-batching scheduler (n_parallel > 1) --------------------
     def _sched_loop(self) -> None:
@@ -360,6 +423,8 @@ class LlmFilter(FilterFramework):
         logits = jnp.zeros((m, cfg.vocab), jnp.float32)
         tok = jnp.zeros((m,), jnp.int32)
         streams: List[Optional[Dict[str, Any]]] = [None] * m
+        with self._cond:
+            self._streams = streams  # published for snapshot_state
         while not self._stop.is_set():
             # -- admit pending prompts into free slots
             with self._cond:
@@ -372,7 +437,7 @@ class LlmFilter(FilterFramework):
                 for slot in range(m):
                     if streams[slot] is None and self._pending:
                         admit.append((slot, *self._pending.pop(0)))
-            for slot, prompt, ctx in admit:
+            for slot, prompt, ctx, rem in admit:
                 try:
                     self._check_prompt(prompt, max_len)
                     l1, c1 = self._prefill_prompt(prompt, max_len)
@@ -383,9 +448,16 @@ class LlmFilter(FilterFramework):
                 logits = logits.at[slot].set(l1[0])
                 # per-stream PRNG key: the sample sequence matches the
                 # n_parallel=1 path for the same seed, independent of
-                # which other prompts happen to be in flight
-                streams[slot] = {"ctx": ctx, "remaining": max_tokens,
+                # which other prompts happen to be in flight. rem
+                # overrides the budget for a stream adopted from a
+                # preemption snapshot (the rest was emitted pre-crash).
+                streams[slot] = {"ctx": ctx,
+                                 "remaining": (max_tokens if rem is None
+                                               else int(rem)),
                                  "pos": int(prompt.size),
+                                 "prompt": np.asarray(prompt,
+                                                      np.int32).copy(),
+                                 "emitted": [],
                                  "key": jax.random.PRNGKey(seed)}
             active_np = np.array([s is not None for s in streams])
             if not active_np.any():
@@ -413,8 +485,12 @@ class LlmFilter(FilterFramework):
                 if s is None:
                     continue
                 self._dispatch([tok_host[slot:slot + 1]], s["ctx"])
-                s["remaining"] -= 1
-                s["pos"] += 1
+                with self._cond:
+                    # bookkeeping under _cond: a preemption snapshot
+                    # reads (prompt, emitted, remaining) coherently
+                    s["emitted"].append(int(tok_host[slot]))
+                    s["remaining"] -= 1
+                    s["pos"] += 1
                 # pos is one past the next decode's cache-write position
                 # (the write lands at pos-1), so the stream survives
                 # while pos <= max_len — matching the single-stream
@@ -472,8 +548,10 @@ class LlmFilter(FilterFramework):
                 continue
             for j in range(min(k, emits_left[slot])):
                 self._dispatch([toks_host[j, slot:slot + 1]], s["ctx"])
-                s["remaining"] -= 1
-                s["pos"] += 1
+                with self._cond:
+                    s["emitted"].append(int(toks_host[j, slot]))
+                    s["remaining"] -= 1
+                    s["pos"] += 1
             if temperature > 0:
                 s["key"] = keys[slot]
             if s["remaining"] <= 0 or s["pos"] > max_len:

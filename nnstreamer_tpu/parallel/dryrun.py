@@ -178,6 +178,10 @@ def run_infer(n_devices: int) -> None:
     results: dict = {}
 
     def client(c):
+        # jittered starts: clients must interleave mid-stream (not line
+        # up batch-aligned), so the order assertion below exercises the
+        # row router against mixed-client batches
+        time.sleep(0.03 * c)
         cl = parse_launch(
             f"appsrc name=in caps={caps} "
             f"! tensor_query_client port={port} timeout=60 max-request=8 "
@@ -204,8 +208,15 @@ def run_infer(n_devices: int) -> None:
     sigs = list(server["f"].fw._jit_cache)
     server.stop()
     total = n_clients * frames_each
-    assert n_invokes < total, \
-        f"no micro-batching: {n_invokes} invokes for {total} frames"
+    import math
+    # a perfectly coalescing server needs ceil(total/4) stacked
+    # invokes; +2 tolerates ragged head/tail batches from the jittered
+    # client starts. More than that means micro-batching degraded to
+    # near-per-frame dispatch (the regression this guard exists for).
+    bound = math.ceil(total / 4) + 2
+    assert n_invokes <= bound, \
+        f"micro-batching degraded: {n_invokes} invokes for {total} " \
+        f"frames (bound {bound})"
     assert any(sig and sig[0][0] and sig[0][0][0] == 4 for sig in sigs), \
         f"no stacked (batch=4) signature compiled: {sigs}"
     for c in range(n_clients):

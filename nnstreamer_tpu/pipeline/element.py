@@ -298,6 +298,48 @@ class Element:
         path."""
         return None
 
+    # -- checkpoint/restore (checkpoint/) ----------------------------------
+    # one-line capability note for docs/pipelint: None means the element
+    # holds no state worth snapshotting; a string describes what
+    # snapshot_state() persists (see Documentation/robustness.md —
+    # "surviving preemption" — and checkpoint/store.py)
+    CHECKPOINTABLE: Optional[str] = None
+
+    def snapshot_state(self, snap_dir: str) -> Optional[Dict]:
+        """Serialize this element's live state for a crash-consistent
+        snapshot. ``snap_dir`` is a per-element scratch directory inside
+        the snapshot-in-progress for bulk artifacts (the trainer's orbax
+        params tree); the returned dict is pickled as the element's
+        blob, and both are integrity-hashed into the snapshot manifest.
+        Return None for "no state right now" (no blob written). Base:
+        stateless, never called (Pipeline.snapshot only collects from
+        overriders)."""
+        return None
+
+    def restore_state(self, state: Dict, snap_dir: str) -> None:
+        """Rebuild state captured by :meth:`snapshot_state`. Called by
+        ``Pipeline.restore`` BEFORE ``start()`` — elements whose backing
+        resources come up in start() stash the state and apply it
+        there."""
+
+    def preempt(self) -> None:
+        """Preemption quiesce hook (``Pipeline.preempt``): cheap and
+        non-blocking — stop admitting new work and nudge in-flight work
+        toward completion, but never wait. Runs even on the degraded
+        (no-drain) path, so side effects that must reach peers (a serve
+        source's DRAIN notify to its router) belong here. Default:
+        delegate to :meth:`drain`. Elements whose drain() *finishes*
+        work rather than stopping it (the trainer runs epochs to
+        completion) override to pause instead."""
+        self.drain()
+
+    def preempt_inflight(self) -> int:
+        """Frames this element has admitted but not yet settled, counted
+        at snapshot time when the grace deadline forced the no-drain
+        path. Whatever is reported here is *declared* abandoned in the
+        preempt report and snapshot manifest — never silently lost."""
+        return 0
+
     def set_src_caps(self, caps: Caps, pad: Optional[Pad] = None) -> None:
         pads = [pad] if pad is not None else list(self.src_pads.values())
         for p in pads:

@@ -152,6 +152,15 @@ class BucketBatcher:
             return {"depth": len(self._fifo),
                     "streams": len(self._per_stream)}
 
+    def ledger(self) -> List[Dict[str, Any]]:
+        """Identity of every admitted-but-unbatched request — the
+        pending ledger a preemption snapshot records so a restarted
+        replica can DECLARE what was in flight (the router's failover
+        re-dispatches them; a late duplicate settles as an orphan)."""
+        with self._cond:
+            return [{"stream": r.stream_id, "seq": r.seq, "pts": r.pts}
+                    for r in self._fifo]
+
     # -- the consumer ------------------------------------------------------
     def bucket_for(self, n: int) -> int:
         """Smallest bucket >= n (the largest bucket caps a run)."""

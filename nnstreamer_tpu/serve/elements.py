@@ -71,6 +71,10 @@ class TensorServeSrc(SrcElement):
         self._listener: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
         self._next_client = [0]
+        # checkpoint/: pending ledger + session ids recovered by
+        # restore_state, applied at start() (REGISTER advertises the
+        # restored sessions so the fleet knows this replica resurrected)
+        self._restored: Optional[Dict] = None
         # cid -> (conn, send lock, negotiated wire config): replies come
         # from the sink's streaming thread, sheds from the batcher and
         # recv threads — the per-connection lock keeps wire frames
@@ -103,6 +107,11 @@ class TensorServeSrc(SrcElement):
             max_queue=int(self.max_queue),
             deadline_s=float(self.deadline_ms) / 1e3,
             name=self.name)
+        if self._restored is not None:
+            # declare (never replay) the pre-crash pending ledger: reply
+            # routes died with the old process, the router's failover
+            # owns re-dispatch, late duplicates settle as orphans
+            self.scheduler.record_recovered(self._restored.get("ledger"))
         register_scheduler(self.id, self.scheduler)
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -120,11 +129,15 @@ class TensorServeSrc(SrcElement):
                 self._broker_sock = socket.create_connection(
                     (self.dest_host or "localhost", int(self.dest_port)),
                     timeout=self.timeout)
+                reg_meta = dict(self.scheduler.occupancy(), role="serve")
+                if self._restored is not None:
+                    # resurrection announcement: the router counts these
+                    # and knows the replica carries restored session ids
+                    reg_meta["restored_sessions"] = list(
+                        self._restored.get("sessions") or [])
                 send_msg(self._broker_sock, MsgKind.REGISTER,
                          {"topic": self.topic, "host": self.host,
-                          "port": self.bound_port,
-                          "meta": dict(self.scheduler.occupancy(),
-                                       role="serve")})
+                          "port": self.bound_port, "meta": reg_meta})
             except OSError:
                 # don't leak a half-started server: closing the listener
                 # also terminates the accept thread
@@ -141,6 +154,7 @@ class TensorServeSrc(SrcElement):
                 self._listener = None
                 unregister_scheduler(self.id)
                 raise
+        self._restored = None
         super().start()
 
     def stop(self) -> None:
@@ -334,6 +348,30 @@ class TensorServeSrc(SrcElement):
             _sever(conn)
         self.stats.inc("link_kills", len(victims))
         return len(victims)
+
+    # -- checkpoint/restore (checkpoint/) ----------------------------------
+    CHECKPOINTABLE = ("the pending-request ledger (declared, not "
+                      "replayed) + connected client ids")
+
+    def snapshot_state(self, snap_dir):
+        if self.scheduler is None:
+            return self._restored  # restored but never started: re-emit
+        ledger = self.scheduler.pending_ledger()
+        with self._clock:
+            sessions = sorted(self._conns)
+        if not ledger and not sessions:
+            return None
+        return {"ledger": ledger, "sessions": sessions}
+
+    def restore_state(self, state, snap_dir):
+        # applied at start(): the fresh scheduler records the recovered
+        # ledger and REGISTER advertises restored_sessions to the fleet
+        self._restored = state
+
+    def preempt_inflight(self) -> int:
+        # admitted-but-unsettled requests abandoned by a degraded
+        # (no-drain) preemption — declared in the preempt report
+        return self.scheduler.pending() if self.scheduler is not None else 0
 
     # -- the src loop ------------------------------------------------------
     def create(self) -> Optional[Buffer]:

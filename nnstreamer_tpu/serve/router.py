@@ -489,13 +489,24 @@ class FleetRouter:
             except OSError:
                 pass
             return False
+        rejoined = False
         with self._rlock:
             rep.sock = sock
             rep.slock = threading.Lock()
             rep.cfg = cfg
             rep.gen += 1
             rep.hb = Heartbeat(self.heartbeat_s, self.heartbeat_miss)
+            # a fresh link is a fresh replica: a process resurrected at
+            # the same host:port must not inherit the corpse's DRAINING
+            # flag (it would be routable never again)
+            if rep.draining:
+                rep.draining = False
+                rejoined = True
             self._rebuild_ring_locked()
+        if rejoined:
+            self.stats.inc("router_replica_rejoins")
+            logger.info("%s: replica %s rejoined (draining flag cleared)",
+                        self.name, rep.key)
         threading.Thread(target=self._replica_loop, args=(rep, sock),
                          name=f"router-replica:{rep.key}",
                          daemon=True).start()
@@ -682,6 +693,15 @@ class FleetRouter:
                     fresh.append(rep)
                 if isinstance(info, dict) and not rep.load:
                     rep.load = info  # REGISTER occupancy seeds the load
+                if isinstance(info, dict) and info.get("restored_sessions") \
+                        and rep in fresh:
+                    # the replica came back from a preemption snapshot
+                    # carrying restored session ids: count the
+                    # resurrection (chaos asserts it happened exactly once)
+                    self.stats.inc("router_replica_resurrections")
+                    logger.info("%s: replica %s resurrected with %d "
+                                "restored session(s)", self.name, key,
+                                len(info["restored_sessions"]))
             # a replica the broker no longer advertises AND whose link is
             # gone has left the fleet; a live link outranks a flapping
             # broker, so connected members are never evicted here

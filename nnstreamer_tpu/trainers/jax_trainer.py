@@ -79,6 +79,16 @@ class JaxTrainer(TrainerFramework):
         self._done_evt = threading.Event()
         self._eos_evt = threading.Event()
         self.params = None
+        # coherent (epoch, params, opt_state) published after every
+        # completed step — the ONLY state the preemption snapshot reads,
+        # so a snapshot can never see params from step N with optimizer
+        # moments from step N-1
+        self._ckpt_lock = threading.Lock()
+        self._ckpt = None
+        # restore-and-resume (checkpoint/): epoch to resume AFTER, and
+        # the host-side optimizer state to rebuild from
+        self._resume_epoch = 0
+        self._resume_opt = None
 
     # -- lifecycle --------------------------------------------------------
     def create(self, props: TrainerProperties) -> None:
@@ -136,6 +146,72 @@ class JaxTrainer(TrainerFramework):
 
     def destroy(self) -> None:
         self._stop_evt.set()
+
+    # -- preemption checkpoint/restore (checkpoint/) -----------------------
+    def pause(self) -> None:
+        """Preemption quiesce: stop at the next step boundary (the loop's
+        stop-checks guarantee no partial optimizer update) and join the
+        training thread so :meth:`snapshot` reads settled state. Unlike
+        ``stop()`` this saves nothing to model-save-path — the snapshot
+        store owns persistence on this path."""
+        self._stop_evt.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=60.0)
+
+    def snapshot(self, snap_dir: str) -> Optional[Dict]:
+        """Serialize the last published (epoch, params, opt_state):
+        params through the orbax path (trainers/checkpoint.py) into
+        ``snap_dir``, optimizer moments host-side into the returned
+        blob. Epoch semantics: ``epoch`` steps are COMPLETE; resume runs
+        ``epoch+1..epochs`` — never a repeated or skipped update."""
+        import jax
+        with self._ckpt_lock:
+            ckpt = self._ckpt
+        if ckpt is None:
+            # no step completed since create/restore: snapshot initial
+            # params so restore still lands on a runnable model
+            ckpt = (self._resume_epoch, self.params, self._resume_opt)
+        epoch, params, opt_state = ckpt
+        if params is None:
+            return None
+        import os
+        from .checkpoint import save_params
+        save_params(os.path.join(snap_dir, "params"), params)
+        host_opt = None
+        if opt_state is not None:
+            host_opt = jax.device_get(opt_state)
+        return {"epoch": int(epoch), "opt_state": host_opt,
+                "status": vars(self.get_status())}
+
+    def resume_from(self, state: Dict, snap_dir: str) -> None:
+        """Apply a :meth:`snapshot` blob after :meth:`create` and before
+        :meth:`start`: params reload through orbax (mesh-aware like the
+        model-load-path route), the epoch counter resumes exactly after
+        the recorded step, and the optimizer moments are handed to the
+        training loop to rebuild on device."""
+        import os
+        from .checkpoint import restore_params
+        assert self._props is not None, "resume_from requires create()"
+        like = self.params
+        if self._props.mesh:
+            from ..parallel.mesh import mesh_from_spec
+            from ..parallel.sharding import rules_by_name, shard_params
+            like = shard_params(self.params,
+                                rules_by_name(self._props.rules or ""),
+                                mesh_from_spec(self._props.mesh))
+        self.params = restore_params(os.path.join(snap_dir, "params"), like)  # racecheck: ok(resume_from runs from restore_state before start(): the training worker does not exist yet)
+        self._resume_epoch = int(state.get("epoch", 0))
+        self._resume_opt = state.get("opt_state")
+        st = state.get("status") or {}
+        with self._status_lock:
+            self._status = TrainerStatus(**st) if st else TrainerStatus(
+                epoch=self._resume_epoch)
+        with self._ckpt_lock:
+            self._ckpt = (self._resume_epoch, self.params,
+                          self._resume_opt)
+        logger.info("jax trainer: resuming after epoch %d",
+                    self._resume_epoch)
 
     # -- data -------------------------------------------------------------
     def push_data(self, tensors: Sequence[Any]) -> None:
@@ -195,6 +271,19 @@ class JaxTrainer(TrainerFramework):
             mesh = mesh_from_spec(p.mesh)
             rules = rules_by_name(p.rules or "")
             state = ptrain.create_train_state(self.params, opt, mesh, rules)
+            if self._resume_opt is not None:
+                # land the restored host moments directly on each fresh
+                # moment's sharding; on any mismatch keep the fresh init
+                # (training stays correct, momentum restarts cold)
+                try:
+                    state.opt_state = jax.tree_util.tree_map(
+                        lambda h, l: jax.device_put(
+                            jnp.asarray(h), l.sharding)
+                        if hasattr(l, "sharding") else jnp.asarray(h),
+                        self._resume_opt, state.opt_state)
+                except (TypeError, ValueError):
+                    logger.warning("jax trainer: restored optimizer state "
+                                   "does not match; reinitializing moments")
             self.params = state.params
             ndp = mesh.shape.get("data", 1)
 
@@ -217,7 +306,11 @@ class JaxTrainer(TrainerFramework):
 
             opt_state = state.opt_state
         else:
-            opt_state = jax.jit(opt.init)(self.params)
+            if self._resume_opt is not None:
+                opt_state = jax.tree_util.tree_map(jnp.asarray,
+                                                   self._resume_opt)
+            else:
+                opt_state = jax.jit(opt.init)(self.params)
 
             @jax.jit
             def step(params, opt_state, inputs, labels):
@@ -235,7 +328,7 @@ class JaxTrainer(TrainerFramework):
         try:
             train: Optional[List[List[np.ndarray]]] = None
             val: Optional[List[List[np.ndarray]]] = None
-            for epoch in range(1, p.epochs + 1):
+            for epoch in range(self._resume_epoch + 1, p.epochs + 1):
                 if self._stop_evt.is_set():
                     return
                 # drain this epoch's samples from the stream; on a short
@@ -264,6 +357,10 @@ class JaxTrainer(TrainerFramework):
                 with self._status_lock:
                     self._status = TrainerStatus(
                         epoch, float(loss), float(acc), vloss, vacc)
+                # publish the step-coherent checkpoint tuple the
+                # preemption snapshot reads — epoch N fully applied
+                with self._ckpt_lock:
+                    self._ckpt = (epoch, self.params, opt_state)
                 self._emit(TrainerEvent.EPOCH_COMPLETION, self.get_status())
             self._emit(TrainerEvent.TRAINING_COMPLETION, self.get_status())
         except Exception:  # noqa: BLE001

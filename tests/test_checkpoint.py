@@ -1,0 +1,691 @@
+"""Preemption-safe pipelines: crash-consistent checkpoint/restore,
+SIGTERM drain-and-snapshot, and replica resurrection.
+
+Fast tests cover the SnapshotStore integrity rules (a truncated blob or
+tampered manifest is rejected by NAME, never silently partially
+restored), per-element snapshot/restore round-trips, the degraded
+preempt path (snapshot-without-drain with abandoned frames declared),
+the pipelint ``stateful-no-checkpoint`` rule, and an in-process trainer
+resume at the exact recorded epoch.
+
+The slow (``-m slow``, ``make chaos-preempt``) acceptance runs kill real
+processes with SIGTERM: mid-training (restart resumes at the exact
+epoch, no repeated or skipped optimizer updates) and mid-serving (the
+killed fleet replica is resurrected from its snapshot and the router's
+ledger still balances exactly).
+"""
+import os
+import pickle
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import Buffer, parse_launch
+from nnstreamer_tpu.analysis import Severity, analyze
+from nnstreamer_tpu.checkpoint import (MANIFEST, SnapshotError,
+                                       SnapshotStore)
+from nnstreamer_tpu.filters import register_custom_easy
+from nnstreamer_tpu.pipeline.element import SinkElement
+from nnstreamer_tpu.pipeline.registry import register_element
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CAPS4 = ('other/tensors,format=static,num_tensors=1,'
+         'types=(string)float32,dimensions=(string)4,'
+         'framerate=(fraction)0/1')
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _ckpt_models():
+    register_custom_easy("ckpt_double", lambda x: x * 2)
+    yield
+
+
+@register_element("ckpt_hold_sink")
+class _HoldSink(SinkElement):
+    """Test sink whose rendered frames count as still-in-flight: the
+    degraded preempt path must DECLARE them as abandoned."""
+
+    CHECKPOINTABLE = "the held frame count"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._held = 0
+
+    def render(self, buf):
+        self._held += 1
+
+    def preempt_inflight(self):
+        return self._held
+
+    def snapshot_state(self, snap_dir):
+        return {"held": self._held} if self._held else None
+
+    def restore_state(self, state, snap_dir):
+        self._held = int(state["held"])
+
+
+@register_element("ckpt_amnesiac_sink")
+class _AmnesiacSink(SinkElement):
+    """Seeded pipelint defect: declares it cannot survive a restart but
+    implements no snapshot hook."""
+
+    RESTART_SAFE = False
+
+    def render(self, buf):
+        pass
+
+
+# -------------------------------------------------------------- store
+
+def _one_blob_snapshot(root, payload=b"snapshot-bytes " * 64):
+    store = SnapshotStore(str(root), retain=3)
+
+    def writer(tmp):
+        os.makedirs(os.path.join(tmp, "elements"))
+        with open(os.path.join(tmp, "elements", "a.blob"), "wb") as f:
+            f.write(payload)
+
+    return store, store.save(writer, meta={"kind": "unit"})
+
+
+class TestSnapshotStore:
+    def test_save_publishes_atomically_and_verifies(self, tmp_path):
+        store, snap = _one_blob_snapshot(tmp_path / "ckpt")
+        assert store.latest() == snap
+        assert not [n for n in os.listdir(store.root)
+                    if n.startswith(".tmp-")]
+        manifest = SnapshotStore.verify(snap)
+        assert manifest["meta"] == {"kind": "unit"}
+        assert "elements/a.blob" in manifest["files"]
+
+    def test_tampered_blob_rejected_by_name(self, tmp_path):
+        _, snap = _one_blob_snapshot(tmp_path / "ckpt")
+        path = os.path.join(snap, "elements", "a.blob")
+        raw = bytearray(open(path, "rb").read())
+        raw[0] ^= 0xFF  # same size, different content
+        open(path, "wb").write(bytes(raw))
+        with pytest.raises(SnapshotError) as exc:
+            SnapshotStore.verify(snap)
+        assert exc.value.blob == "elements/a.blob"
+        assert "sha256 mismatch" in str(exc.value)
+
+    def test_truncated_blob_rejected_by_name(self, tmp_path):
+        _, snap = _one_blob_snapshot(tmp_path / "ckpt")
+        path = os.path.join(snap, "elements", "a.blob")
+        with open(path, "r+b") as f:
+            f.truncate(10)
+        with pytest.raises(SnapshotError) as exc:
+            SnapshotStore.verify(snap)
+        assert exc.value.blob == "elements/a.blob"
+        assert "truncated" in str(exc.value)
+
+    def test_missing_blob_rejected_by_name(self, tmp_path):
+        _, snap = _one_blob_snapshot(tmp_path / "ckpt")
+        os.remove(os.path.join(snap, "elements", "a.blob"))
+        with pytest.raises(SnapshotError) as exc:
+            SnapshotStore.verify(snap)
+        assert exc.value.blob == "elements/a.blob"
+
+    def test_malformed_manifest_rejected(self, tmp_path):
+        _, snap = _one_blob_snapshot(tmp_path / "ckpt")
+        mpath = os.path.join(snap, MANIFEST)
+        open(mpath, "w").write("{not json")
+        with pytest.raises(SnapshotError) as exc:
+            SnapshotStore.verify(snap)
+        assert exc.value.blob == MANIFEST
+        open(mpath, "w").write('{"version": 99, "files": {}}')
+        with pytest.raises(SnapshotError):
+            SnapshotStore.verify(snap)
+
+    def test_retain_n_gc_keeps_newest(self, tmp_path):
+        store = SnapshotStore(str(tmp_path / "ckpt"), retain=2)
+        for i in range(5):
+            store.save(lambda tmp, i=i: open(
+                os.path.join(tmp, "x.blob"), "wb").write(bytes([i])))
+        snaps = store.snapshots()
+        assert len(snaps) == 2
+        assert [os.path.basename(s) for s in snaps] == \
+            ["snap-00000004", "snap-00000005"]
+        assert store.latest() == snaps[-1]
+
+    def test_crashed_tmp_dirs_swept(self, tmp_path):
+        root = tmp_path / "ckpt"
+        os.makedirs(root / ".tmp-snap-00000001-999")
+        _, snap = _one_blob_snapshot(root)
+        assert not [n for n in os.listdir(root) if n.startswith(".tmp-")]
+        SnapshotStore.verify(snap)
+
+
+# ----------------------------------------------- pipeline snapshot path
+
+def _agg_desc():
+    return (f'appsrc name=in caps="{CAPS4}" '
+            '! tensor_aggregator name=agg frames-out=3 frames-flush=3 '
+            'frames-dim=0 ! appsink name=out')
+
+
+def _push4(pipe, values):
+    for v in values:
+        pipe["in"].push_buffer(Buffer.from_arrays(
+            [np.full(4, float(v), np.float32)]))
+
+
+def _wait(cond, timeout=10):
+    deadline = time.monotonic() + timeout
+    while not cond() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert cond()
+
+
+class TestPipelinePreemptRestore:
+    def test_aggregator_window_survives_preemption(self, tmp_path):
+        ckpt = str(tmp_path / "ckpt")
+        pipe = parse_launch(_agg_desc())
+        pipe.start()
+        _push4(pipe, [1, 2])  # 2 of the 3-frame window
+        _wait(lambda: len(pipe["agg"]._window) == 2)
+        report = pipe.preempt(0.5, ckpt)
+        assert report["snapshot"] and not report["drained"]
+
+        pipe2 = parse_launch(_agg_desc())
+        meta = pipe2.restore(ckpt)
+        assert meta["preempt"]["drained"] is False
+        pipe2.start()
+        _push4(pipe2, [3])  # completes the restored window
+        pipe2["in"].end_stream()
+        pipe2.wait_eos(10)
+        out = pipe2["out"].buffers
+        pipe2.stop()
+        assert len(out) == 1
+        np.testing.assert_array_equal(
+            out[0].chunks[0].host(),
+            np.repeat([1.0, 2.0, 3.0], 4).astype(np.float32))
+
+    def test_restore_rejects_tampered_snapshot(self, tmp_path):
+        ckpt = str(tmp_path / "ckpt")
+        pipe = parse_launch(_agg_desc())
+        pipe.start()
+        _push4(pipe, [1, 2])
+        _wait(lambda: len(pipe["agg"]._window) == 2)
+        pipe.preempt(0.5, ckpt)
+        snap = SnapshotStore(ckpt).latest()
+        blob = os.path.join(snap, "elements", "agg.blob")
+        raw = bytearray(open(blob, "rb").read())
+        raw[-1] ^= 0xFF
+        open(blob, "wb").write(bytes(raw))
+
+        pipe2 = parse_launch(_agg_desc())
+        with pytest.raises(SnapshotError) as exc:
+            pipe2.restore(ckpt)
+        assert exc.value.blob == "elements/agg.blob"
+        # NO partial restore happened: the window is still empty
+        assert not pipe2["agg"]._window
+
+    def test_restore_requires_stopped_pipeline(self, tmp_path):
+        ckpt = str(tmp_path / "ckpt")
+        pipe = parse_launch(_agg_desc())
+        pipe.start()
+        _push4(pipe, [1])
+        _wait(lambda: len(pipe["agg"]._window) == 1)
+        pipe.preempt(0.5, ckpt)
+        pipe2 = parse_launch(_agg_desc())
+        pipe2.start()
+        with pytest.raises(RuntimeError, match="before start"):
+            pipe2.restore(ckpt)
+        pipe2["in"].end_stream()
+        pipe2.stop()
+
+    def test_degraded_preempt_declares_abandoned(self, tmp_path):
+        ckpt = str(tmp_path / "ckpt")
+        desc = f'appsrc name=in caps="{CAPS4}" ! ckpt_hold_sink name=hold'
+        pipe = parse_launch(desc)
+        pipe.start()
+        _push4(pipe, [1, 2, 3])
+        _wait(lambda: pipe["hold"]._held == 3)
+        # the src never EOSes: a short grace degrades to
+        # snapshot-without-drain, with the in-flight count DECLARED
+        report = pipe.preempt(0.4, ckpt)
+        assert report["drained"] is False
+        assert report["abandoned"] == {"hold": 3}
+        assert pipe["hold"].stats["preempt_abandoned"] == 3
+        snap = SnapshotStore(ckpt).latest()
+        meta = SnapshotStore.verify(snap)["meta"]
+        assert meta["preempt"]["abandoned"] == {"hold": 3}
+
+        pipe2 = parse_launch(desc)
+        pipe2.restore(ckpt)
+        assert pipe2["hold"]._held == 3
+
+
+# ------------------------------------------------ element round trips
+
+class TestElementRoundTrips:
+    def test_tensor_rate_schedule(self, tmp_path):
+        a = parse_launch(f'appsrc caps="{CAPS4}" '
+                         '! tensor_rate name=r framerate=30/1 ! fakesink')
+        r = a["r"]
+        r._next_ts = 123456
+        r._last_in_pts = 99
+        r._throttling = True
+        r._prev = Buffer.from_arrays([np.full(4, 7.0, np.float32)])
+        state = r.snapshot_state(str(tmp_path))
+
+        b = parse_launch(f'appsrc caps="{CAPS4}" '
+                         '! tensor_rate name=r framerate=30/1 ! fakesink')
+        r2 = b["r"]
+        r2.restore_state(state, str(tmp_path))
+        assert r2._next_ts == 123456 and r2._last_in_pts == 99
+        assert r2._throttling is True
+        np.testing.assert_array_equal(r2._prev.chunks[0].host(),
+                                      r._prev.chunks[0].host())
+
+    def test_repo_slot_queue_and_eos(self, tmp_path):
+        from nnstreamer_tpu.elements.repo import GLOBAL_REPO
+        try:
+            GLOBAL_REPO.push(61, Buffer.from_arrays(
+                [np.full(4, 1.0, np.float32)]))
+            GLOBAL_REPO.push(61, Buffer.from_arrays(
+                [np.full(4, 2.0, np.float32)]))
+            GLOBAL_REPO.set_eos(61)
+            a = parse_launch(f'appsrc caps="{CAPS4}" '
+                             '! tensor_reposink name=rs slot-index=61')
+            state = a["rs"].snapshot_state(str(tmp_path))
+            b = parse_launch(f'appsrc caps="{CAPS4}" '
+                             '! tensor_reposink name=rs slot-index=62')
+            b["rs"].restore_state(state, str(tmp_path))
+            bufs, eos = GLOBAL_REPO.snapshot_slot(62)
+            assert eos and len(bufs) == 2
+            np.testing.assert_array_equal(bufs[1].chunks[0].host(),
+                                          np.full(4, 2.0, np.float32))
+        finally:
+            GLOBAL_REPO.restore_slot(61, [], False)
+            GLOBAL_REPO.restore_slot(62, [], False)
+
+    def test_edge_replay_ring(self):
+        from nnstreamer_tpu.edge.session import ReplayRing
+        ring = ReplayRing(budget_bytes=1 << 20)
+        for seq in (4, 5, 6):
+            ring.append(seq, Buffer.from_arrays(
+                [np.full(4, float(seq), np.float32)]))
+        frames, evicted = ring.dump()
+        ring2 = ReplayRing(budget_bytes=1 << 20)
+        ring2.load(frames, evicted)
+        assert len(ring2) == 3
+        frames2, evicted2 = ring2.dump()
+        assert [s for s, _ in frames2] == [4, 5, 6]
+        assert evicted2 == evicted
+
+    def test_llm_stream_snapshot_and_adoption(self):
+        from nnstreamer_tpu.filters.llm import LlmFilter
+        f = LlmFilter()
+        with f._cond:
+            f._streams = [
+                {"prompt": np.array([5, 6], np.int32),
+                 "emitted": [7, 8], "remaining": 4, "pos": 4},
+                None,
+            ]
+            f._pending = [(np.array([1, 2, 3], np.int32), None, None)]
+        state = f.snapshot_state(None)
+        assert state == {"streams": [
+            {"prompt": [5, 6], "emitted": [7, 8], "remaining": 4},
+            {"prompt": [1, 2, 3], "emitted": [], "remaining": None},
+        ]}
+
+        g = LlmFilter()
+        g.restore_state(state, None)
+        with g._cond:
+            # a non-matching prompt is NOT adopted
+            rem, flat = g._adopt_recovered_locked(
+                np.array([9, 9], np.int32))
+            assert rem is None and flat.tolist() == [9, 9]
+            # the matching prompt resumes mid-stream: emitted tokens are
+            # grafted onto the prefill and the budget picks up where it
+            # left off
+            rem, flat = g._adopt_recovered_locked(
+                np.array([5, 6], np.int32))
+            assert rem == 4 and flat.tolist() == [5, 6, 7, 8]
+            rem, flat = g._adopt_recovered_locked(
+                np.array([1, 2, 3], np.int32))
+            assert rem is None and flat.tolist() == [1, 2, 3]
+            assert g._recovered is None  # fully consumed
+
+    def test_serve_src_ledger_declared_on_restart(self, tmp_path):
+        desc = (f"tensor_serve_src name=src port={_free_port()} id=9 "
+                "buckets=1,2,4 max-wait-ms=2 "
+                "! tensor_filter framework=custom-easy model=ckpt_double "
+                "! tensor_serve_sink id=9")
+        state = {"ledger": [{"stream": "s1", "seq": 3, "pts": 30}],
+                 "sessions": ["s1"]}
+        pipe = parse_launch(desc)
+        src = pipe["src"]
+        src.restore_state(state, str(tmp_path))
+        # restored-but-never-started: the state re-emits unchanged
+        assert src.snapshot_state(str(tmp_path)) == state
+        pipe.start()
+        try:
+            assert src.scheduler.recovered_ledger == state["ledger"]
+            assert src.scheduler.stats["recovered_pending"] == 1
+        finally:
+            pipe.stop()
+
+
+# ------------------------------------------------------------ pipelint
+
+class TestStatefulNoCheckpointRule:
+    def _findings(self, desc):
+        report = analyze(parse_launch(desc))
+        return [f for f in report.findings
+                if f.rule == "stateful-no-checkpoint"]
+
+    def test_warns_on_restart_unsafe_without_hook(self):
+        got = self._findings(  # pipelint: skip — seeded missing hook
+            f'appsrc caps="{CAPS4}" ! ckpt_amnesiac_sink name=x')
+        assert len(got) == 1
+        assert got[0].severity is Severity.WARNING
+        assert got[0].element == "x"
+
+    def test_clean_when_hook_present(self):
+        # tensor_rate and tensor_aggregator declare RESTART_SAFE=False
+        # but implement snapshot_state: no finding
+        assert not self._findings(
+            f'appsrc caps="{CAPS4}" ! tensor_rate framerate=30/1 '
+            '! tensor_aggregator frames-out=2 ! fakesink')
+
+
+# ------------------------------------------------------ trainer resume
+
+def _write_dataset(tmp_path, n=16, in_dim=8, classes=4):
+    import json
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(n, in_dim)).astype(np.float32)
+    ys = np.zeros((n, classes), np.float32)
+    labels = rng.integers(0, classes, n)
+    ys[np.arange(n), labels] = 1.0
+    xs += labels[:, None] * 2.0
+    data = tmp_path / "train.data"
+    with open(data, "wb") as f:
+        for x, y in zip(xs, ys):
+            f.write(x.tobytes() + y.tobytes())
+    index = {
+        "gst_caps": ("other/tensors, format=(string)static, "
+                     "framerate=(fraction)0/1, num_tensors=(int)2, "
+                     f"dimensions=(string){in_dim}.{classes}, "
+                     "types=(string)float32.float32"),
+        "total_samples": n,
+        "sample_size": (in_dim + classes) * 4,
+    }
+    jpath = tmp_path / "train.json"
+    jpath.write_text(json.dumps(index))
+    return data, jpath
+
+
+def _trainer_desc(data, jpath, src_epochs, total_epochs, n=16):
+    return (f'datareposrc location={data} json={jpath} is-shuffle=false '
+            f'epochs={src_epochs} '
+            '! tensor_trainer name=t framework=jax '
+            'model-config="zoo://mlp?in_dim=8&hidden=16&out_dim=4&lr=0.05" '
+            f'num-training-samples={n} epochs={total_epochs} '
+            'num-inputs=1 num-labels=1 ! appsink name=out')
+
+
+class TestTrainerResume:
+    def test_resumes_at_exact_epoch(self, tmp_path):
+        """Train 3 epochs, snapshot, restore into a 6-epoch run: the
+        second run must train EXACTLY epochs 4..6 — no epoch repeated,
+        none skipped."""
+        data, jpath = _write_dataset(tmp_path)
+        ckpt = str(tmp_path / "ckpt")
+        pipe = parse_launch(_trainer_desc(data, jpath, 3, 3))
+        pipe.start()
+        pipe.wait_eos(120)
+        report = pipe.preempt(2.0, ckpt)
+        assert report["drained"] is True
+        snap = SnapshotStore(ckpt).latest()
+        state = pickle.loads(
+            open(os.path.join(snap, "elements", "t.blob"), "rb").read())
+        assert state["epoch"] == 3
+
+        pipe2 = parse_launch(_trainer_desc(data, jpath, 3, 6))
+        pipe2.restore(ckpt)
+        pipe2.start()
+        pipe2.wait_eos(120)
+        stats = pipe2["out"].buffers
+        pipe2.stop()
+        epochs = [int(b.pts) for b in stats]
+        assert epochs[0] == 4          # resumed AFTER the recorded step
+        assert sorted(set(epochs)) == [4, 5, 6]
+        assert epochs[-1] == 6         # ran to the new horizon
+
+
+# ------------------------------------------------- chaos (slow, SIGTERM)
+
+def _spawn_py(code):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    return subprocess.Popen([sys.executable, "-c", code], cwd=REPO,
+                            env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+
+
+def _read_until(proc, token, count=1, timeout=120):
+    """Read child stdout lines until ``token`` appeared ``count`` times;
+    returns all lines read."""
+    lines = []
+    seen = 0
+    deadline = time.monotonic() + timeout
+
+    def reader():
+        nonlocal seen
+        for line in proc.stdout:
+            lines.append(line.rstrip("\n"))
+            if token in line:
+                seen += 1
+                if seen >= count:
+                    return
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    while t.is_alive() and time.monotonic() < deadline:
+        if proc.poll() is not None and seen < count:
+            t.join(timeout=1)
+            break
+        time.sleep(0.05)
+    assert seen >= count, \
+        f"never saw {count}x {token!r} (exit={proc.poll()}): {lines[-20:]}"
+    return lines
+
+
+def _stop(proc):
+    if proc.poll() is None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
+@pytest.mark.slow
+class TestPreemptChaos:
+    def test_sigterm_mid_training_resumes_exact_step(self, tmp_path):
+        """kill -TERM a training process mid-run; the restarted process
+        resumes at the exact recorded epoch — every epoch across the two
+        lives trains exactly once."""
+        total = 400
+        data, jpath = _write_dataset(tmp_path)
+        ckpt = str(tmp_path / "ckpt")
+        desc = _trainer_desc(data, jpath, total, total)
+        code = (
+            "import time\n"
+            "from nnstreamer_tpu import parse_launch\n"
+            "from nnstreamer_tpu.checkpoint import install_sigterm\n"
+            f"pipe = parse_launch({desc!r})\n"
+            f"install_sigterm(pipe, {ckpt!r}, grace_s=2.0, exit_code=0)\n"
+            "pipe.start()\n"
+            "seen = 0\n"
+            "deadline = time.monotonic() + 300\n"
+            "while time.monotonic() < deadline:\n"
+            "    n = len(pipe['out'].buffers)\n"
+            "    while seen < n:\n"
+            "        seen += 1\n"
+            "        print('epoch-frame', seen, flush=True)\n"
+            "    time.sleep(0.005)\n")
+        proc = _spawn_py(code)
+        try:
+            _read_until(proc, "epoch-frame", count=5, timeout=240)
+            proc.send_signal(signal.SIGTERM)
+            assert proc.wait(timeout=60) == 0  # clean preempted exit
+        finally:
+            _stop(proc)
+
+        snap = SnapshotStore(ckpt).latest()
+        assert snap is not None
+        state = pickle.loads(
+            open(os.path.join(snap, "elements", "t.blob"), "rb").read())
+        k = state["epoch"]
+        assert 1 <= k < total, f"kill landed outside the run: epoch {k}"
+
+        # restart: feed exactly the REMAINING passes over the data and
+        # resume from the snapshot
+        pipe = parse_launch(_trainer_desc(data, jpath, total - k, total))
+        pipe.restore(ckpt)
+        pipe.start()
+        pipe.wait_eos(300)
+        stats = pipe["out"].buffers
+        pipe.stop()
+        epochs = [int(b.pts) for b in stats]
+        # exactness: the second life trains epochs k+1..total, each
+        # exactly once — no repeated and no skipped optimizer updates
+        assert epochs[0] == k + 1
+        assert sorted(set(epochs)) == list(range(k + 1, total + 1))
+        assert epochs[-1] == total
+
+    def test_replica_killed_mid_serving_resurrects(self, tmp_path):
+        """kill -TERM one fleet replica mid-serving; it snapshots, the
+        restarted process restores and rejoins via the broker, and the
+        router's ledger balances exactly (declared_lost only for
+        explicitly abandoned frames — here zero)."""
+        from nnstreamer_tpu.edge.broker import DiscoveryBroker
+
+        n_clients, n_frames = 4, 8
+        broker = DiscoveryBroker(port=0)
+        broker.start()
+        ports = [_free_port(), _free_port()]
+        ckpt = str(tmp_path / "replica-ckpt")
+
+        def replica_code(port, ident, restore):
+            return (
+                "import time\n"
+                "from nnstreamer_tpu import parse_launch\n"
+                "from nnstreamer_tpu.checkpoint import install_sigterm\n"
+                "from nnstreamer_tpu.filters import register_custom_easy\n"
+                "register_custom_easy('ckpt_double', lambda x: x * 2)\n"
+                "pipe = parse_launch(\n"
+                f"    'tensor_serve_src name=src port={port} id={ident} '\n"
+                "    'buckets=1,2,4 max-wait-ms=2 connect-type=HYBRID '\n"
+                f"    'topic=ckpt-fleet dest-port={broker.bound_port} '\n"
+                "    '! tensor_filter framework=custom-easy "
+                "model=ckpt_double '\n"
+                f"    '! tensor_serve_sink id={ident}')\n"
+                + (f"pipe.restore({ckpt!r})\n" if restore else "")
+                + f"install_sigterm(pipe, {ckpt!r}, grace_s=1.5, "
+                "exit_code=0)\n"
+                "pipe.start()\n"
+                "print('replica-ready', flush=True)\n"
+                "while True:\n"
+                "    time.sleep(0.5)\n")
+
+        reps = [_spawn_py(replica_code(ports[i], 80 + i, False))
+                for i in range(2)]
+        rp = None
+        clients = []
+        try:
+            for proc in reps:
+                _read_until(proc, "replica-ready", timeout=120)
+            rp = parse_launch(
+                "tensor_serve_router name=rt port=0 topic=ckpt-fleet "
+                f"dest-port={broker.bound_port} requery-ms=100 "
+                "heartbeat-ms=50 breaker-reset-ms=300")
+            rp.start()
+            rt = rp["rt"]
+            _wait(lambda: len(rt.router.replica_keys()) == 2, timeout=15)
+
+            def mk_client():
+                c = parse_launch(
+                    f'appsrc name=in caps="{CAPS4}" '
+                    f"! tensor_query_client name=qc port={rt.bound_port} "
+                    "timeout=15 max-request=8 ! appsink name=out")
+                c.start()
+                return c
+
+            def settled(c):
+                return len(c["out"].buffers) + c["qc"].stats["shed"]
+
+            clients = [mk_client() for _ in range(n_clients)]
+            half = n_frames // 2
+            for tag, c in enumerate(clients):
+                _push4(c, [100 * tag + i for i in range(half)])
+            for c in clients:
+                _wait(lambda c=c: settled(c) >= half, timeout=60)
+
+            # SIGTERM the first replica: drain-and-snapshot, clean exit
+            reps[0].send_signal(signal.SIGTERM)
+            assert reps[0].wait(timeout=60) == 0
+            assert SnapshotStore(ckpt).latest() is not None
+            # resurrect it from the snapshot on the same port
+            reps[0] = _spawn_py(replica_code(ports[0], 80, True))
+            _read_until(reps[0], "replica-ready", timeout=120)
+            _wait(lambda: len(rt.router.replica_keys()) == 2, timeout=20)
+
+            for tag, c in enumerate(clients):
+                _push4(c, [100 * tag + i for i in range(half, n_frames)])
+            for c in clients:
+                _wait(lambda c=c: settled(c) >= n_frames, timeout=60)
+
+            for tag, c in enumerate(clients):
+                st = c["qc"].stats.snapshot()
+                got = sorted(float(b.chunks[0].host()[0])
+                             for b in c["out"].buffers)
+                # RESULT xor SHED for every frame, zero declared lost
+                assert len(got) + st["shed"] == n_frames, (tag, st)
+                assert st["session_declared_lost"] == 0, (tag, st)
+                assert len(got) == len(set(got)), (tag, got)
+                assert c._error is None
+
+            st = rt.stats.snapshot()
+            assert st["router_requests"] == n_clients * n_frames
+            # the ledger balances exactly across the replica's death
+            # and resurrection
+            assert st["router_requests"] == (
+                st["router_delivered"] + st["router_shed"] +
+                st["router_orphaned"])
+            assert st["router_replica_deaths"] >= 1
+            assert (st.get("router_replica_rejoins", 0) +
+                    st.get("router_replica_resurrections", 0)) >= 1
+        finally:
+            for c in clients:
+                try:
+                    c["in"].end_stream()
+                    c.stop()
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+            if rp is not None:
+                rp.stop()
+            for proc in reps:
+                _stop(proc)
+            broker.stop()

@@ -77,6 +77,10 @@ def main() -> int:
         if fusible:
             out.append(f"**Device-fusible (fusion compiler):** {fusible}")
             out.append("")
+        ckpt = getattr(cls, "CHECKPOINTABLE", None)
+        if ckpt:
+            out.append(f"**Checkpointable (preemption snapshot):** {ckpt}")
+            out.append("")
         props = {}
         for klass in reversed(cls.__mro__):
             props.update(getattr(klass, "PROPS", {}))
