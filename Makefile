@@ -10,7 +10,7 @@ LIB := $(BUILD)/libnnstpu.so
 EXAMPLES := $(BUILD)/custom_passthrough.so $(BUILD)/custom_scaler.so
 
 .PHONY: native clean test check tier1 lint racecheck chaos chaos-zeroloss \
-	chaos-fleet chaos-preempt fuse-parity async-parity package
+	chaos-fleet chaos-preempt fuse-parity async-parity shard-parity package
 
 native: $(LIB) $(EXAMPLES)
 
@@ -23,6 +23,7 @@ check: native lint racecheck
 	python -c "import nnstreamer_tpu as nt; print('import ok:', len(nt.pipeline.registry.element_names()), 'elements')"
 	$(MAKE) fuse-parity
 	$(MAKE) async-parity
+	$(MAKE) shard-parity
 	$(MAKE) chaos
 	$(MAKE) chaos-fleet
 	$(MAKE) chaos-preempt
@@ -40,6 +41,14 @@ fuse-parity:
 # be invisible in the sink bytes (and in their order).
 async-parity:
 	env JAX_PLATFORMS=cpu python tools/fuse_parity.py --mode async
+
+# `make shard-parity` = the sharded-serving byte-parity oracle: every
+# mesh-declaring pipeline in the corpus (plus a built-in representative
+# suite) must produce byte-identical sink output sharded across the
+# 8-virtual-device mesh and single-chip (tools/shard_parity.py exits
+# nonzero on any divergence, and on vacuous coverage).
+shard-parity:
+	env JAX_PLATFORMS=cpu python tools/shard_parity.py
 
 # `make chaos` = the full fault-injection harness: the slow seeded
 # serve-pipeline schedules (excluded from tier-1 by the slow marker)

@@ -267,6 +267,98 @@ class ShardingRule(Rule):
                     f"batch axis, device_put will fail", filt.name, "sink")
 
 
+class ServeMeshRule(Rule):
+    """Serve topology of the sharding rule: a bucketed
+    ``tensor_serve_src`` stacks batches at its configured bucket sizes,
+    so when the stream feeds a ``mesh:DxSxT`` filter every bucket must
+    divide the data-parallel axis — one indivisible bucket means every
+    batch that lands in it runs replicated (all rows on every chip)
+    instead of sharded. The src's own ``mesh=`` property snaps buckets
+    to dp multiples at start; the ERROR fires on the buckets as they
+    would actually stack."""
+
+    id = "serve-mesh-divisibility"
+    severity = Severity.ERROR
+    _MESH = re.compile(r"(?:^|,)mesh:(\d+)x(\d+)x(\d+)")
+
+    @staticmethod
+    def _effective_buckets(src) -> List[int]:
+        try:
+            buckets = [int(b) for b in str(src.buckets).split(",")
+                       if b.strip()]
+        except ValueError:
+            return []
+        spec = str(getattr(src, "mesh", "") or "")
+        if spec:
+            from ..parallel.mesh import spec_dims
+            dims = spec_dims(spec)
+            if dims is not None and dims[0] > 1:
+                snap = dims[0]
+                buckets = sorted({-(-b // snap) * snap
+                                  for b in buckets if b > 0})
+        return buckets
+
+    def check(self, ctx: LintContext):
+        for filt in ctx.of_kind("tensor_filter"):
+            m = self._MESH.search(str(filt.custom))
+            if not m:
+                continue
+            dp = int(m.group(1))
+            if dp <= 1:
+                continue
+            for src in ctx.sources_feeding(filt):
+                if kind_of(src) != "tensor_serve_src":
+                    continue
+                bad = [b for b in self._effective_buckets(src) if b % dp]
+                if bad:
+                    yield self.finding(
+                        f"serve buckets {bad} do not divide the mesh's "
+                        f"data-parallel axis {dp} (custom="
+                        f"{filt.custom!r}); those batches run replicated "
+                        f"on every chip — declare mesh= on {src.name!r} "
+                        f"to snap buckets, or fix the bucket list",
+                        filt.name, "sink")
+
+
+class MeshColocationRule(Rule):
+    """Train/serve colocation shares ONE device pool: a
+    ``tensor_trainer mesh=X`` next to a serving path declaring
+    ``mesh:Y`` (filter custom or serve src property) with X != Y builds
+    two different Mesh objects over the same chips — params cannot stay
+    mesh-resident across both, so each side's device_put evicts the
+    other's layout. Declaring one spec makes them share the memoized
+    mesh (parallel.mesh.shared_mesh)."""
+
+    id = "mesh-colocation"
+    severity = Severity.WARNING
+    _MESH = re.compile(r"(?:^|,)mesh:([^,]+)")
+
+    def check(self, ctx: LintContext):
+        serve_specs = {}
+        for filt in ctx.of_kind("tensor_filter"):
+            m = self._MESH.search(str(filt.custom))
+            if m and m.group(1).strip():
+                serve_specs.setdefault(m.group(1).strip(), filt.name)
+        for src in ctx.of_kind("tensor_serve_src"):
+            spec = str(getattr(src, "mesh", "") or "").strip()
+            if spec:
+                serve_specs.setdefault(spec, src.name)
+        if not serve_specs:
+            return
+        for tr in ctx.of_kind("tensor_trainer"):
+            spec = str(getattr(tr, "mesh", "") or "").strip()
+            if not spec:
+                continue
+            for other, where in sorted(serve_specs.items()):
+                if other != spec:
+                    yield self.finding(
+                        f"trainer mesh={spec!r} but {where!r} declares "
+                        f"mesh {other!r} on the same device pool: the "
+                        f"two sides rebuild different meshes and evict "
+                        f"each other's params; declare one spec so they "
+                        f"share the mesh", tr.name)
+
+
 class SinklessBranchRule(Rule):
     """Data flowing into an element whose src pads go nowhere is
     silently dropped; a pipeline with no sink at all never reaches
@@ -741,7 +833,8 @@ class StatefulNoCheckpointRule(Rule):
 
 ALL_RULES: List[Rule] = [
     DanglingPadRule(), CycleRule(), TeeNoQueueRule(), JitSignatureRule(),
-    ShardingRule(), SinklessBranchRule(), CombinerDtypeRule(),
+    ShardingRule(), ServeMeshRule(), MeshColocationRule(),
+    SinklessBranchRule(), CombinerDtypeRule(),
     UnboundedAdmissionRule(), LinkResilienceRule(), ErrorPolicyRule(),
     WireConfigRule(), FusionBreakRule(), FusionTransferRule(),
     SessionReplayBudgetRule(), SessionNoReconnectRule(),

@@ -257,6 +257,8 @@ class TensorFilter(Element):
                             self.name, window, self.fw.NAME)
             else:
                 from .overlap import OverlapExecutor
+                mesh = getattr(self.fw, "mesh", None)
+                devices = len(mesh.devices.ravel()) if mesh is not None else 1
                 self._overlap = OverlapExecutor(
                     window,
                     complete_cb=self._complete_frame,
@@ -264,7 +266,8 @@ class TensorFilter(Element):
                     push_cb=self.push,
                     name=self.name,
                     reorder=bool(self.reorder),
-                    reorder_deadline_s=float(self.reorder_deadline_ms) / 1e3)
+                    reorder_deadline_s=float(self.reorder_deadline_ms) / 1e3,
+                    devices=devices)
 
     def drain(self) -> None:
         """During a deliberate drain the filter may sit idle for longer
@@ -433,7 +436,8 @@ class TensorFilter(Element):
 
     # -- device placement (fusion compiler) --------------------------------
     DEVICE_FUSIBLE = ("sync jax-backend invokes on static caps "
-                      "(no mesh sharding, no invoke-async/dynamic)")
+                      "(no invoke-async/dynamic; mesh-sharded members "
+                      "fuse when the run shares one mesh spec)")
 
     _JAX_FRAMEWORKS = ("jax", "jax-tpu", "flax")
 
@@ -442,8 +446,6 @@ class TensorFilter(Element):
             return "invoke-async: output frames are decoupled from inputs"
         if self.invoke_dynamic:
             return "invoke-dynamic: per-frame output shapes (dynamic caps)"
-        if "mesh:" in str(self.custom):
-            return "mesh-sharded invoke (pjit placement owns the program)"
         fw = (self.framework or "").lower()
         if fw in ("auto", ""):
             first = self.model.split(",")[0] if self.model else ""
@@ -454,6 +456,17 @@ class TensorFilter(Element):
         if fw not in self._JAX_FRAMEWORKS:
             return f"framework {fw!r} exposes no traceable invoke"
         return None
+
+    def mesh_spec(self) -> str:
+        """The declared ``mesh:`` custom option (e.g. ``"2x2x2"``,
+        ``"auto"``), "" when unsharded. Static — readable before the
+        framework opens; the fusion planner uses it to break runs at
+        mesh-spec boundaries (one fused program, one mesh)."""
+        for part in str(self.custom or "").split(","):
+            part = part.strip()
+            if part.startswith("mesh:"):
+                return part[len("mesh:"):].strip()
+        return ""
 
     def plan_out_caps(self, incaps: Caps) -> Optional[Caps]:
         """Plan-time refinement of :meth:`static_transfer`: opens the

@@ -165,8 +165,13 @@ class OverlapExecutor:
                  push_cb: Callable[[Any], None],
                  name: str = "overlap",
                  reorder: bool = True,
-                 reorder_deadline_s: float = 1.0):
-        self.window = InFlightWindow(limit)
+                 reorder_deadline_s: float = 1.0,
+                 devices: int = 1):
+        # the window budget is per-MESH, not per-chip: one dispatched
+        # frame occupies one slot even when its sharded program spans
+        # ``devices`` chips (a sharded invoke is still a single XLA
+        # dispatch with a single completion)
+        self.window = InFlightWindow(limit, devices=devices)
         self._complete_cb = complete_cb
         self._error_cb = error_cb
         self._push_cb = push_cb

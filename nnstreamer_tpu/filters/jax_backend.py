@@ -174,16 +174,42 @@ class JaxFilter(FilterFramework):
             self._jit_cache[key] = exe
         return exe
 
+    @property
+    def mesh(self):
+        """The live Mesh in mesh mode (None per-chip) — read by the
+        fused-segment compiler, the in-flight window's per-mesh slot
+        accounting, and trace.report()'s devices fields."""
+        return self._mesh
+
     def _input_sharding(self, x):
         """Shard the batch (dim 0) over the ``data`` axis when divisible;
         replicate otherwise. XLA propagates from these committed inputs +
         the param shardings and inserts the ICI collectives."""
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        ndp = self._mesh.shape.get("data", 1)
-        if x.ndim > 0 and ndp > 1 and x.shape[0] % ndp == 0:
-            return NamedSharding(self._mesh,
-                                 P("data", *([None] * (x.ndim - 1))))
-        return NamedSharding(self._mesh, P())
+        from ..parallel.sharding import batch_sharding
+        return batch_sharding(self._mesh, x.ndim,
+                              x.shape[0] if x.ndim else 0)
+
+    def _place_inputs(self, inputs):
+        """Mesh placement of one invoke's inputs. An input the serve
+        scheduler already committed with the wanted sharding passes
+        through untouched — placement upstream (overlapped with
+        batching) makes the dispatch leg here O(1), which keeps the
+        windowed dispatch/complete latency split honest for sharded
+        programs."""
+        import jax
+        xs = []
+        for x in inputs:
+            if isinstance(x, jax.Array):
+                if x.sharding == self._input_sharding(x):
+                    xs.append(x)
+                    continue
+                # device-resident but laid out differently: reshard on
+                # device (device_put only reads shape/ndim on the host)
+                xs.append(jax.device_put(x, self._input_sharding(x)))
+            else:
+                x = np.asarray(x)
+                xs.append(jax.device_put(x, self._input_sharding(x)))
+        return xs
 
     def invoke(self, inputs: Sequence[Any]) -> List[Any]:
         import jax
@@ -191,15 +217,15 @@ class JaxFilter(FilterFramework):
             if self._suspended:
                 self._resume()
             if self._mesh is not None:
-                # keep jax.Arrays device-resident: _input_sharding only
-                # reads shape/ndim, and device_put reshards on device
-                xs = [jax.device_put(
-                          x if isinstance(x, jax.Array) else np.asarray(x),
-                          self._input_sharding(x))
-                      for x in inputs]
+                xs = self._place_inputs(inputs)
             else:
-                xs = [x if isinstance(x, jax.Array) else
-                      jax.device_put(np.asarray(x), self._device)
+                # a mesh-committed upstream output (sharded filter or
+                # serve placement) must collapse to this chip: jit
+                # refuses mixed device sets otherwise
+                xs = [x if isinstance(x, jax.Array)
+                      and len(x.sharding.device_set) == 1 else
+                      jax.device_put(x if isinstance(x, jax.Array)
+                                     else np.asarray(x), self._device)
                       for x in inputs]
             sig = tuple((tuple(x.shape), str(x.dtype)) for x in xs)
             out = self._executable(sig)(self._params, *xs)
@@ -228,15 +254,16 @@ class JaxFilter(FilterFramework):
                 self._resume()
             donate_idx: Tuple[int, ...] = ()
             if self._mesh is not None:
-                xs = [jax.device_put(
-                          x if isinstance(x, jax.Array) else np.asarray(x),
-                          self._input_sharding(x))
-                      for x in inputs]
+                xs = self._place_inputs(inputs)
             else:
                 xs = []
                 staged: List[int] = []
                 for i, x in enumerate(inputs):
                     if isinstance(x, jax.Array):
+                        if len(x.sharding.device_set) > 1:
+                            # mesh-committed upstream output: collapse
+                            # to this chip (upstream-owned, not donated)
+                            x = jax.device_put(x, self._device)
                         xs.append(x)
                     else:
                         xs.append(jax.device_put(np.asarray(x),
@@ -266,13 +293,19 @@ class JaxFilter(FilterFramework):
         apply/params, for the fusion compiler to inline into a larger
         jit program (fusion/segment.py). Params are captured by value:
         the closure stays valid across suspend/reload, it just keeps
-        serving the params it was planned with. None in mesh mode —
-        there pjit sharding owns the program placement."""
+        serving the params it was planned with.
+
+        In mesh mode the closed-over params are mesh-committed
+        jax.Arrays, so the fused program compiles over the mesh with
+        XLA propagating the param shardings ("computation follows
+        data"); the segment pins batch-major layout at each member
+        boundary via its sharding constraints, so a fused run stays
+        mesh-resident end to end."""
         with self._lock:
             if self._suspended:
                 self._resume()
             apply_fn, params = self._apply, self._params
-            if apply_fn is None or self._mesh is not None:
+            if apply_fn is None:
                 return None
 
         def fn(*xs):

@@ -23,6 +23,16 @@ weather-resilience verdict the bench row checks.
 Custom properties (``custom=rtt:60,svc:5,fail-every:0``):
   * ``rtt``        link round trip per frame, ms (default 0)
   * ``svc``        serial service time per frame, ms (default 0)
+  * ``svc-row``    serial service time PER BATCH ROW, ms (default 0) —
+                   with it a stacked batch of R rows costs
+                   ``svc + svc-row * ceil(R / dp)``
+  * ``mesh``       a ``DxSxT`` spec whose data-parallel degree divides
+                   the per-row service across simulated chips (default
+                   dp=1). The mesh half of the ``sharded_serve`` bench
+                   row: rows of one batch run dp-wide, so batch service
+                   scales as ceil(R/dp) — the deterministic stand-in
+                   for a real pod's batch-major fan-out (the 1-core CI
+                   host cannot show a real dp speedup)
   * ``fail-every`` raise on every Nth frame's completion (0 = never) —
                    chaos hook for breaker/shed accounting with frames
                    in flight
@@ -65,6 +75,8 @@ class SimLinkFilter(FilterFramework):
     def __init__(self):
         self._rtt_s = 0.0
         self._svc_s = 0.0
+        self._svc_row_s = 0.0
+        self._dp = 1
         self._fail_every = 0
         self._in_info: Optional[TensorsInfo] = None
         # frame counter for fail-every: dispatched from the chain
@@ -77,6 +89,11 @@ class SimLinkFilter(FilterFramework):
         opts = _parse_custom(props.custom_properties)
         self._rtt_s = float(opts.get("rtt", 0.0)) / 1e3
         self._svc_s = float(opts.get("svc", 0.0)) / 1e3
+        self._svc_row_s = float(opts.get("svc-row", 0.0)) / 1e3
+        self._dp = 1
+        if "mesh" in opts:
+            from ..parallel.mesh import spec_dp
+            self._dp = max(1, spec_dp(str(opts["mesh"])))
         self._fail_every = int(opts.get("fail-every", 0))
         self._in_info = props.input_info
 
@@ -99,6 +116,17 @@ class SimLinkFilter(FilterFramework):
         return [(np.asarray(x) * 2 + 1).astype(np.asarray(x).dtype)
                 for x in inputs]
 
+    def _svc(self, inputs: Sequence[Any]) -> float:
+        """Per-frame service time: the flat ``svc`` plus the per-row
+        cost with the rows of one stacked batch spread dp-wide —
+        ``svc + svc-row * ceil(rows / dp)``, rows = leading dim."""
+        svc = self._svc_s
+        if self._svc_row_s > 0.0 and len(inputs):
+            x = np.asarray(inputs[0])
+            rows = int(x.shape[0]) if x.ndim else 1
+            svc += self._svc_row_s * (-(-rows // self._dp))
+        return svc
+
     def _tick(self) -> int:
         with self._lock:
             self._n += 1
@@ -111,7 +139,7 @@ class SimLinkFilter(FilterFramework):
     # -- synchronous path: the full serial cost per frame -----------------
     def invoke(self, inputs: Sequence[Any]) -> List[Any]:
         n = self._tick()
-        time.sleep(self._rtt() + self._svc_s)
+        time.sleep(self._rtt() + self._svc(inputs))
         self._maybe_fail(n)
         return self._compute(inputs)
 
@@ -131,7 +159,8 @@ class SimLinkFilter(FilterFramework):
         left = deadline - time.monotonic()
         if left > 0:
             time.sleep(left)
-        if self._svc_s > 0:
-            time.sleep(self._svc_s)
+        svc = self._svc(inputs)
+        if svc > 0:
+            time.sleep(svc)
         self._maybe_fail(n)
         return self._compute(inputs)

@@ -18,9 +18,11 @@ Segment boundaries (kept on :attr:`FusionPlan.vetoes` for
 observability): sources, sinks, queues (deliberate thread boundaries),
 multi-pad fan-in/out (mux/demux/tee/crop), edge/query links, stateful
 elements (aggregator/trainer — no ``device_fn``), unknown or non-STATIC
-caps, 64-bit dtypes (jax x64 is off), and a change of ``on-error``
+caps, 64-bit dtypes (jax x64 is off), a change of ``on-error``
 policy mid-run (a segment applies ONE policy; splitting keeps each
-member under the policy its author chose).
+member under the policy its author chose), and a change of ``mesh:``
+spec mid-run (one fused program compiles for one mesh — uniform
+members stay mesh-resident across member boundaries instead).
 """
 from __future__ import annotations
 
@@ -155,6 +157,16 @@ def _policy_of(elem: Element) -> str:
     return str(getattr(elem, "on_error", "fail"))
 
 
+def _mesh_of(elem: Element) -> str:
+    """The member's declared ``mesh:`` spec ("" = unsharded). A fused
+    program runs under ONE placement: every member must agree, so a
+    spec change breaks the run (mixing meshes inside one jit would
+    force cross-mesh reshards at member boundaries — exactly the
+    transfers fusion exists to delete)."""
+    get = getattr(elem, "mesh_spec", None)
+    return str(get()) if callable(get) else ""
+
+
 def _linked_sink(elem: Element):
     """The element's sole linked sink pad (candidates have exactly one,
     which need not be the FIRST declared pad)."""
@@ -190,6 +202,12 @@ def plan_fusion(pipeline, inference: Optional[InferenceResult] = None,
             plan.vetoes.setdefault(
                 elem.name, f"on-error policy changes mid-run "
                            f"({_policy_of(prev)!r} -> {_policy_of(elem)!r})")
+            return False
+        if _mesh_of(prev) != _mesh_of(elem):
+            plan.vetoes.setdefault(
+                elem.name, f"mesh spec changes mid-run "
+                           f"({_mesh_of(prev)!r} -> {_mesh_of(elem)!r}); "
+                           f"one fused program runs on one mesh")
             return False
         return True
 

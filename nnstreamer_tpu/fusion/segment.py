@@ -72,8 +72,18 @@ class FusedSegment(TransformElement):
         # per-caps-signature compiled programs; only the segment's
         # streaming thread touches it (one segment = one thread)
         self._programs: dict = {}
+        # the run's (uniform — the planner breaks runs on a mesh-spec
+        # change) device mesh: when set, the fused program pins a
+        # batch-major layout at every member boundary and inputs are
+        # committed to the mesh before dispatch, so a fused run stays
+        # mesh-resident end to end instead of collapsing to one chip
+        self._mesh = next(
+            (m for m in (getattr(getattr(e, "fw", None), "mesh", None)
+                         for e in members) if m is not None), None)
         self.stats.update(jit_hits=0, jit_misses=0, shed=0,
-                          breaker_opened=0, fused_elements=len(members))
+                          breaker_opened=0, fused_elements=len(members),
+                          devices=(len(self._mesh.devices.ravel())
+                                   if self._mesh is not None else 1))
         # strongest member breaker settings win; 0 threshold = no breaker
         self._breaker = None
         self.breaker_threshold = max(
@@ -127,7 +137,9 @@ class FusedSegment(TransformElement):
                 push_cb=self.push,
                 name=self.name,
                 reorder=bool(self.reorder),
-                reorder_deadline_s=float(self.reorder_deadline_ms) / 1e3)
+                reorder_deadline_s=float(self.reorder_deadline_ms) / 1e3,
+                devices=(len(self._mesh.devices.ravel())
+                         if self._mesh is not None else 1))
 
     def drain(self) -> None:
         super().drain()
@@ -184,6 +196,11 @@ class FusedSegment(TransformElement):
             return
         arrays = [c.raw for c in buf.chunks]
         sig = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+        if self._mesh is not None:
+            # commit inputs batch-major before dispatch; arrays the
+            # serve scheduler already placed pass through untouched
+            from ..parallel.sharding import place_batch
+            arrays = place_batch(arrays, self._mesh)
         t0 = time.perf_counter_ns()
         exe = self._programs.get(sig)
         if exe is None:
@@ -262,11 +279,34 @@ class FusedSegment(TransformElement):
     def _compile(self):
         import jax
         fns = self._fns
+        mesh = self._mesh
+        if mesh is not None and len(mesh.devices.ravel()) > 1:
+            from ..parallel.sharding import batch_sharding
 
-        def program(arrs):
-            for fn in fns:
-                arrs = fn(arrs)
-            return arrs
+            def pin(arrs):
+                # batch-major at every member boundary: without the
+                # constraint XLA may re-layout mid-program activations
+                # around a tensor-parallel member and pay an all-gather
+                # at the next batch-parallel stage
+                return [jax.lax.with_sharding_constraint(
+                            a, batch_sharding(
+                                mesh, a.ndim,
+                                a.shape[0] if a.ndim else 0))
+                        for a in arrs]
+
+            def program(arrs):
+                arrs = pin(arrs)
+                for fn in fns:
+                    arrs = fn(arrs)
+                    if not isinstance(arrs, (list, tuple)):
+                        arrs = [arrs]
+                    arrs = pin(arrs)
+                return arrs
+        else:
+            def program(arrs):
+                for fn in fns:
+                    arrs = fn(arrs)
+                return arrs
 
         # one jax.jit object per caps signature: jit would retrace a
         # shared object silently, which would skew the hit/miss stats

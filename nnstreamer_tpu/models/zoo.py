@@ -78,6 +78,31 @@ def _build_toyseg(height: str = "8", width: str = "8", classes: str = "5",
     return apply_fn, params, in_info, out_info
 
 
+@register_model("toyscale")
+def _build_toyscale(height: str = "8", width: str = "8", classes: str = "5",
+                    seed: str = "1"):
+    """Elementwise per-class affine over [H, W, C] logits -> [H, W, C]
+    (a toy calibration head). Chains after ``toyseg`` as the second
+    link of the fusion byte-parity oracle: elementwise-only like
+    toyseg, so a toyseg!toyscale segment stays bit-exact across XLA
+    fusion AND mesh partitioning decisions."""
+    import jax
+    import jax.numpy as jnp
+
+    h, w, c = int(height), int(width), int(classes)
+    k1, k2 = jax.random.split(jax.random.PRNGKey(int(seed)))
+    params = {
+        "scale": jax.random.normal(k1, (c,), jnp.float32),
+        "shift": jax.random.normal(k2, (c,), jnp.float32),
+    }
+
+    def apply_fn(p, x):
+        return x.astype(jnp.float32) * p["scale"] + p["shift"]
+
+    info = TensorsInfo.make("float32", f"{h}:{w}:{c}")
+    return apply_fn, params, info, info.copy()
+
+
 @register_model("mlp")
 def _build_mlp(in_dim: str = "64", hidden: str = "128", out_dim: str = "10",
                seed: str = "0", dtype: str = "bfloat16"):

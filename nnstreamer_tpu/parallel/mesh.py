@@ -9,7 +9,8 @@ slice and DCN across slices.
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+import threading
+from typing import Dict, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -17,6 +18,13 @@ from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
 AXES = ("data", "seq", "model")
+
+# one Mesh object per (logical shape, device set): a serving filter and a
+# colocated trainer declaring the same spec get the SAME mesh — one device
+# pool, two workloads, neither evicting the other's params (train/serve
+# colocation). Mesh is immutable, so sharing is safe across threads.
+_SHARED: Dict[Tuple, Mesh] = {}
+_SHARED_LOCK = threading.Lock()
 
 
 def make_mesh(shape: Sequence[int], axis_names: Sequence[str] = AXES,
@@ -34,16 +42,65 @@ def make_mesh(shape: Sequence[int], axis_names: Sequence[str] = AXES,
     return Mesh(arr, tuple(axis_names))
 
 
+def spec_dims(spec: str) -> Optional[Tuple[int, int, int]]:
+    """Parse an explicit ``"DxSxT"`` spec into (dp, sp, tp) without
+    touching devices; None for ``auto``/``true``/empty (device-count
+    dependent) or anything unparseable."""
+    if not spec or spec in ("auto", "true"):
+        return None
+    try:
+        dims = [int(d) for d in str(spec).lower().split("x")]
+    except ValueError:
+        return None
+    if not dims or any(d < 1 for d in dims):
+        return None
+    while len(dims) < 3:
+        dims.append(1)
+    return tuple(dims[:3])  # type: ignore[return-value]
+
+
+def spec_dp(spec: str) -> int:
+    """The data-parallel factor a spec declares: parsed statically for
+    explicit specs (no device access — safe for lint/admission code);
+    ``auto`` consults the backend via :func:`best_mesh`; anything empty
+    or unparseable is 1 (no snapping, no sharding)."""
+    dims = spec_dims(spec)
+    if dims is not None:
+        return dims[0]
+    if spec in ("auto", "true"):
+        try:
+            return factorization(best_mesh())[0]
+        except Exception:  # noqa: BLE001 — no backend: degrade to unsharded
+            return 1
+    return 1
+
+
 def mesh_from_spec(spec: str) -> Mesh:
     """Element-property mesh grammar: ``"2x2x2"`` -> Mesh(dp=2, sp=2,
     tp=2); missing trailing factors default to 1; ``"auto"``/``"true"``
-    factors all visible devices via :func:`best_mesh`."""
+    factors all visible devices via :func:`best_mesh`. Resolved meshes
+    are shared: two elements declaring the same spec over the same
+    device set (a serving filter and a colocated trainer, a serve src
+    and its downstream filter) get one Mesh object."""
     if spec in ("auto", "true"):
-        return best_mesh()
-    dims = [int(d) for d in spec.lower().split("x")]
-    while len(dims) < 3:
-        dims.append(1)
-    return make_mesh(tuple(dims[:3]))
+        return shared_mesh(factorization(best_mesh()))
+    dims = spec_dims(spec)
+    if dims is None:
+        raise ValueError(f"unparseable mesh spec {spec!r} "
+                         f"(want 'DxSxT', 'auto' or 'true')")
+    return shared_mesh(dims)
+
+
+def shared_mesh(dims: Sequence[int]) -> Mesh:
+    """The process-wide shared Mesh for a logical shape over the default
+    device set (see module docstring on colocation)."""
+    dims = tuple(int(d) for d in dims)
+    key = (dims, tuple((d.platform, d.id) for d in jax.devices()))
+    with _SHARED_LOCK:
+        mesh = _SHARED.get(key)
+        if mesh is None:
+            mesh = _SHARED[key] = make_mesh(dims)
+        return mesh
 
 
 def best_mesh(n_devices: Optional[int] = None, model_parallel: int = 0,

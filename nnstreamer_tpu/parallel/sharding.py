@@ -72,3 +72,28 @@ def named_sharding_tree(params: Any, rules: Sequence[Rule], mesh: Mesh) -> Any:
 def shard_params(params: Any, rules: Sequence[Rule], mesh: Mesh) -> Any:
     """Place a param pytree onto the mesh per the rules (H2D reshard)."""
     return jax.device_put(params, named_sharding_tree(params, rules, mesh))
+
+
+def batch_sharding(mesh: Mesh, ndim: int, batch: int) -> NamedSharding:
+    """Batch-major layout for one stacked serve batch: dim 0 split over
+    ``data`` when the batch divides the dp degree, replicated otherwise
+    (an indivisible batch still runs — every chip sees all rows)."""
+    ndp = mesh.shape.get("data", 1)
+    if ndim > 0 and ndp > 1 and batch % ndp == 0:
+        return NamedSharding(mesh, P("data", *([None] * (ndim - 1))))
+    return NamedSharding(mesh, P())
+
+
+def place_batch(arrays: Sequence[Any], mesh: Mesh) -> List[Any]:
+    """device_put a stacked batch onto the mesh batch-major (dim 0 over
+    ``data``). Arrays already committed with the wanted sharding pass
+    through untouched, so placing upstream of the filter costs nothing
+    when the filter re-places."""
+    out = []
+    for a in arrays:
+        want = batch_sharding(mesh, a.ndim, a.shape[0] if a.ndim else 0)
+        if isinstance(a, jax.Array) and a.sharding == want:
+            out.append(a)
+        else:
+            out.append(jax.device_put(a, want))
+    return out

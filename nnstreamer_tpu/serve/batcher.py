@@ -77,11 +77,21 @@ class BucketBatcher:
     """
 
     def __init__(self, buckets: Sequence[int] = (1, 2, 4, 8),
-                 max_wait_s: float = 0.005, max_queue: int = 16):
-        buckets = sorted({int(b) for b in buckets if int(b) > 0})
+                 max_wait_s: float = 0.005, max_queue: int = 16,
+                 snap_multiple: int = 1):
+        # mesh-aware bucket policy: every bucket snaps UP to a multiple
+        # of the data-parallel degree, so a stacked batch always lays
+        # out batch-major across the mesh (dim 0 divisible by dp) and
+        # the jit cache still sees one signature per bucket. Snapping
+        # can only merge buckets (1,2,4,8 @ dp=4 -> 4,8); padded rows
+        # are accounted exactly as before (bucket - len(batch)).
+        snap = max(1, int(snap_multiple))
+        buckets = sorted({-(-int(b) // snap) * snap
+                          for b in buckets if int(b) > 0})
         if not buckets:
             raise ValueError("buckets must name at least one positive size")
         self.buckets = buckets
+        self.snap_multiple = snap
         self.max_wait_s = max(0.0, float(max_wait_s))
         self.max_queue = max(1, int(max_queue))
         self._cond = threading.Condition()

@@ -45,6 +45,12 @@ class TensorServeSrc(SrcElement):
     requests (the demux correlation), and ``batch_valid_rows`` tells the
     filter how many rows are real (padded host rows are sliced off
     before D2H, exactly like the query micro-batch path).
+
+    ``mesh=DxSxT`` makes the serve path mesh-aware: buckets snap up to
+    multiples of the spec's data-parallel degree and every stacked
+    batch is laid out batch-major across the mesh BEFORE dispatch, so
+    a downstream ``custom=mesh:...`` filter runs one sharded invoke
+    per bucket (see Documentation/parallel.md).
     """
 
     PROPS = {"host": "localhost", "port": 3001, "id": 0, "timeout": 10.0,
@@ -64,7 +70,13 @@ class TensorServeSrc(SrcElement):
              # shed with a retry-after instead of invoked
              "deadline-ms": 0.0,
              # the retry-after hint carried by SHED replies
-             "retry-after-ms": 50.0}
+             "retry-after-ms": 50.0,
+             # mesh-aware serving ("DxSxT"/"auto", matching the
+             # downstream filter's custom=mesh:...): buckets snap up to
+             # multiples of the data-parallel degree and each stacked
+             # batch is device_put batch-major across the mesh before
+             # dispatch — one sharded invoke per batch. "" = per-chip.
+             "mesh": ""}
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
@@ -106,7 +118,7 @@ class TensorServeSrc(SrcElement):
             max_wait_s=float(self.max_wait_ms) / 1e3,
             max_queue=int(self.max_queue),
             deadline_s=float(self.deadline_ms) / 1e3,
-            name=self.name)
+            name=self.name, mesh_spec=str(self.mesh))
         if self._restored is not None:
             # declare (never replay) the pre-crash pending ledger: reply
             # routes died with the old process, the router's failover

@@ -55,9 +55,22 @@ class ServeScheduler:
                  max_wait_s: float = 0.005, max_queue: int = 16,
                  deadline_s: float = 0.0,
                  invoke_fn: Optional[Callable] = None,
-                 name: str = "serve"):
+                 name: str = "serve", mesh_spec: str = ""):
         self.name = name
-        self.batcher = BucketBatcher(buckets, max_wait_s, max_queue)
+        # mesh-aware serving: the declared mesh's data-parallel degree
+        # snaps the buckets (every stacked batch divides dp), and
+        # place() lays each stacked batch out batch-major across the
+        # mesh before the filter dispatches — one sharded invoke per
+        # batch instead of one chip doing all rows
+        self.mesh_spec = str(mesh_spec or "")
+        snap = 1
+        if self.mesh_spec:
+            from ..parallel.mesh import spec_dp
+            snap = spec_dp(self.mesh_spec)
+        self.batcher = BucketBatcher(buckets, max_wait_s, max_queue,
+                                     snap_multiple=snap)
+        self._mesh = None          # built lazily on the first place()
+        self._mesh_failed = False  # insufficient devices: degrade once
         self.deadline_s = max(0.0, float(deadline_s))
         self._invoke_fn = invoke_fn
         self._thread: Optional[threading.Thread] = None
@@ -150,7 +163,37 @@ class ServeScheduler:
             for r in batch:
                 self.tracer.observe(f"{self.name}:queue_delay",
                                     (now - r.t_arrival) * 1e9)
-        return batch, bucket, stack_requests(batch, bucket)
+        return batch, bucket, self.place(stack_requests(batch, bucket))
+
+    def place(self, stacked):
+        """Lay a stacked batch out across the declared mesh with a
+        batch-major NamedSharding device_put — BEFORE dispatch, so the
+        downstream filter finds every input already committed and its
+        own placement is a no-op. Degrades to host arrays (logged once)
+        when the mesh cannot be built, e.g. fewer devices than the spec
+        asks for: bucket snapping still applies, sharding does not."""
+        mesh = self._mesh_for_place()
+        if mesh is None:
+            return stacked
+        from ..parallel.sharding import place_batch
+        placed = place_batch(stacked, mesh)
+        self.stats.inc("placed_batches")
+        return placed
+
+    def _mesh_for_place(self):
+        if not self.mesh_spec or self._mesh_failed:
+            return self._mesh
+        if self._mesh is None:
+            try:
+                from ..parallel.mesh import mesh_from_spec
+                self._mesh = mesh_from_spec(self.mesh_spec)
+            except Exception as exc:  # noqa: BLE001 — degrade, keep serving
+                self._mesh_failed = True
+                logger.warning(
+                    "%s: mesh %s unavailable (%s); buckets stay snapped "
+                    "but batches are not mesh-placed", self.name,
+                    self.mesh_spec, exc)
+        return self._mesh
 
     def complete(self, batch: List[Request], outputs: Sequence[Any]) -> None:
         """Demux: row ``i`` of every output tensor goes back to the
@@ -206,7 +249,15 @@ class ServeScheduler:
             qd = self._queue_delay.percentiles()
             bl = self._batch_latency.percentiles()
         filled = s["bucket_rows"] - s["rows_padded"]
+        mesh_info = {}
+        if self.mesh_spec:
+            mesh_info = {"mesh": self.mesh_spec,
+                         "buckets": list(self.batcher.buckets),
+                         "devices": len(self._mesh.devices.ravel())
+                         if self._mesh is not None else 0,
+                         "placed_batches": s.get("placed_batches", 0)}
         return {
+            **mesh_info,
             "batches": b["batches"],
             "requests": b["submitted"],
             "completed": s["completed"],

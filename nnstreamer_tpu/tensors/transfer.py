@@ -384,10 +384,18 @@ class InFlightWindow:
     the overlap: ``overlap_ratio`` is total in-flight frame-seconds
     over the dispatch-to-last-completion wall span — 1.0 means serial
     (no overlap won), ``limit`` means the window ran full depth.
+
+    ``devices`` records how many chips one slot's dispatch spans: the
+    budget is per-mesh, so a batch sharded across an 8-chip mesh still
+    occupies exactly ONE slot (it is one XLA dispatch with one
+    completion), not ``len(mesh.devices)`` — a window of K means K
+    outstanding programs regardless of how wide each program is. The
+    value is reporting-only; it never scales the limit.
     """
 
-    def __init__(self, limit: int):
+    def __init__(self, limit: int, devices: int = 1):
         self.limit = max(1, int(limit))
+        self.devices = max(1, int(devices))
         self._cv = threading.Condition()
         self._inflight = 0
         self._peak = 0
@@ -452,6 +460,7 @@ class InFlightWindow:
                     and self._last_ns is not None else 0)
             return {
                 "window": self.limit,
+                "devices": self.devices,
                 "in_flight": self._inflight,
                 "in_flight_peak": self._peak,
                 "occupancy_avg": round(

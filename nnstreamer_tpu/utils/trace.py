@@ -268,6 +268,12 @@ class Tracer:
                 "members": [m.name for m in getattr(el, "members", [])],
                 "jit_hits": st.get("jit_hits", 0),
                 "jit_misses": st.get("jit_misses", 0),
+                # chips one dispatch of this segment's program spans:
+                # the hit/miss and dispatch-latency numbers are
+                # per-PROGRAM (per-mesh), not per-chip — a sharded
+                # batch is one dispatch, so dividing by devices would
+                # undercount
+                "devices": st.get("devices", 1) or 1,
             }
             # the dispatch-latency series is internal plumbing; fold it
             # into the segment entry instead of a top-level row
@@ -283,6 +289,7 @@ class Tracer:
             "fused_elements": sum(s["elements"] for s in segments.values()),
             "jit_hits": sum(s["jit_hits"] for s in segments.values()),
             "jit_misses": sum(s["jit_misses"] for s in segments.values()),
+            "devices": max(s["devices"] for s in segments.values()),
             "per_segment": segments,
         }
 
@@ -311,6 +318,14 @@ class Tracer:
                       if w.get("overlap_ratio")]
             if ratios:
                 out["overlap_ratio"] = round(max(ratios), 2)
+            # window stats are per-MESH: a sharded in-flight frame is
+            # one slot across every chip its program spans, so the
+            # occupancy/blocked numbers must not be read per-chip —
+            # surface the widest span so the block is self-describing
+            spans = [int(w.get("devices", 1) or 1)
+                     for w in windows.values()]
+            if spans and max(spans) > 1:
+                out["devices"] = max(spans)
         try:
             from ..tensors.transfer import transfer_stats
             svc = transfer_stats()

@@ -149,6 +149,50 @@ class TestRules:
               'input=4 inputtype=float32 custom="mesh:2x1x2" ! fakesink')
         assert findings_for(ok, "sharding-divisibility") == []
 
+    def test_serve_mesh_bucket_indivisible(self):
+        bad = (  # pipelint: skip — bucket 6 on a dp=4 mesh filter
+            "tensor_serve_src name=s buckets=4,6,8 ! "
+            "tensor_filter name=f framework=jax model=zoo://mlp "
+            'custom="mesh:4x1x1" ! tensor_serve_sink')
+        got = findings_for(bad, "serve-mesh-divisibility")
+        assert [(f.element, f.pad) for f in got] == [("f", "sink")]
+        assert got[0].severity is Severity.ERROR
+        assert "[6]" in got[0].message and "replicated" in got[0].message
+
+    def test_serve_mesh_src_snapping_clears_it(self):
+        # the same buckets, but the src's own mesh= snaps them to dp
+        # multiples at start — the lint sees the effective buckets
+        ok = ("tensor_serve_src name=s buckets=4,6,8 mesh=4x1x1 ! "
+              "tensor_filter name=f framework=jax model=zoo://mlp "
+              'custom="mesh:4x1x1" ! tensor_serve_sink')
+        assert findings_for(ok, "serve-mesh-divisibility") == []
+
+    def test_serve_mesh_divisible_is_clean(self):
+        ok = ("tensor_serve_src name=s buckets=4,8 ! "
+              "tensor_filter name=f framework=jax model=zoo://mlp "
+              'custom="mesh:4x1x1" ! tensor_serve_sink')
+        assert findings_for(ok, "serve-mesh-divisibility") == []
+
+    def test_mesh_colocation_mismatch_warns(self):
+        bad = (  # pipelint: skip — trainer and filter declare different meshes
+            f"tensortestsrc caps={CAPS_BATCH6} ! tee name=t "
+            "t. ! queue ! tensor_filter name=f framework=jax "
+            'model=zoo://mlp custom="mesh:2x1x2" ! fakesink '
+            "t. ! queue ! tensor_trainer name=tr framework=jax "
+            "mesh=4x1x1 ! fakesink")
+        got = findings_for(bad, "mesh-colocation")
+        assert [f.element for f in got] == ["tr"]
+        assert got[0].severity is Severity.WARNING
+        assert "share the mesh" in got[0].message
+
+    def test_mesh_colocation_same_spec_is_clean(self):
+        ok = (f"tensortestsrc caps={CAPS_BATCH6} ! tee name=t "
+              "t. ! queue ! tensor_filter name=f framework=jax "
+              'model=zoo://mlp custom="mesh:2x1x2" ! fakesink '
+              "t. ! queue ! tensor_trainer name=tr framework=jax "
+              "mesh=2x1x2 ! fakesink")
+        assert findings_for(ok, "mesh-colocation") == []
+
     def test_sinkless_pipeline_and_dead_end(self):
         bad = (  # pipelint: skip — no sink anywhere, converter dead-ends
             f"tensortestsrc caps={CAPS_U8} ! tensor_converter name=conv")

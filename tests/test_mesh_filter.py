@@ -218,3 +218,143 @@ def test_filter_slices_padded_rows_of_host_outputs():
     f.stop()
     assert len(got) == 1
     assert got[0].chunks[0].shape[0] == 2  # padded rows 2..3 never ship
+
+
+# ---------------------------------------------------- sharded serving
+
+CAPS8x8 = ("other/tensors,format=static,num_tensors=1,"
+           "types=(string)float32,dimensions=(string)8:8,framerate=0/1")
+
+
+def _open_model(model, custom=""):
+    fw = find_filter("jax")()
+    fw.open(FilterProperties(framework="jax", model_files=(model,),
+                             custom_properties=custom))
+    return fw
+
+
+def _sink_bytes(p, sink="out"):
+    out = []
+    for buf in p[sink].buffers:
+        out.append(tuple(
+            (str(np.asarray(c.host()).dtype), np.asarray(c.host()).shape,
+             np.ascontiguousarray(c.host()).tobytes())
+            for c in buf.chunks))
+    return out
+
+
+@pytest.mark.parametrize("model,shape", [
+    ("zoo://mlp?dtype=float32", (64, 64)),
+    ("zoo://toyseg", (64, 8, 8)),
+])
+def test_batch64_sharded_invoke_byte_identical(model, shape):
+    """The serve path's parity contract: a batch-64 invoke laid out
+    batch-major over the 8-device mesh is byte-identical to the
+    single-chip invoke at zoo shapes (f32 matmul precision pinned by
+    conftest)."""
+    x = np.random.RandomState(7).randn(*shape).astype(np.float32)
+    ref = _open_model(model)
+    want = np.asarray(ref.invoke([x])[0])
+    ref.close()
+    fw = _open_model(model, "mesh:8x1x1")
+    out = fw.invoke([x])[0]
+    assert len(out.sharding.device_set) == 8
+    assert np.asarray(out).tobytes() == want.tobytes()
+    fw.close()
+
+
+def test_fused_segment_on_mesh_byte_identical():
+    """A fused run of two mesh-sharded members stays mesh-resident
+    across the member boundary and is byte-identical to both the
+    single-chip fused run and the unfused chain (elementwise oracle
+    chain, like tools/fuse_parity.py uses)."""
+    desc = ('tensortestsrc num-buffers=4 caps={caps} ! '
+            'tensor_filter framework=jax model=zoo://toyseg {c} name=f1 ! '
+            'tensor_filter framework=jax model=zoo://toyscale {c} name=f2 ! '
+            'appsink name=out')
+
+    def run(custom, fuse):
+        p = parse_launch(desc.format(
+            caps=CAPS8x8, c=f"custom={custom}" if custom else ""))
+        p.fuse = fuse
+        p.run(timeout=120)
+        return p
+
+    def segs(p):
+        return [e for e in p.elements.values()
+                if getattr(e, "IS_FUSED_SEGMENT", False)]
+
+    plain = run("", fuse=False)
+    fused = run("", fuse=True)
+    meshed = run("mesh:8x1x1", fuse=True)
+    sg = segs(meshed)
+    assert len(sg) == 1, "mesh members did not fuse"
+    assert sg[0].stats["fused_elements"] == 2
+    assert sg[0].stats["devices"] == 8
+    assert not segs(plain)
+    a, b, c = _sink_bytes(plain), _sink_bytes(fused), _sink_bytes(meshed)
+    assert len(a) == len(b) == len(c) == 4
+    assert a == b == c, "sharded fused run is not byte-identical"
+
+
+def test_mesh_spec_change_breaks_fused_run():
+    """One fused program runs on one mesh: members declaring different
+    mesh specs must not share a segment."""
+    p = parse_launch(
+        f'tensortestsrc num-buffers=2 caps={CAPS8x8} ! '
+        'tensor_filter framework=jax model=zoo://toyseg '
+        'custom=mesh:8x1x1 name=f1 ! '
+        'tensor_filter framework=jax model=zoo://toyscale name=f2 ! '
+        'appsink name=out')
+    p.fuse = True
+    p.run(timeout=120)
+    assert not [e for e in p.elements.values()
+                if getattr(e, "IS_FUSED_SEGMENT", False)]
+    assert "mesh spec changes mid-run" in p._fusion_plan.vetoes["f2"]
+
+
+def test_sharded_dispatch_occupies_one_window_slot():
+    """The in-flight window budgets per MESH: one dispatched sharded
+    batch takes one slot (one XLA dispatch), not len(mesh.devices)."""
+    from nnstreamer_tpu.tensors.transfer import InFlightWindow
+    w = InFlightWindow(2, devices=8)
+    t1 = w.acquire()
+    t2 = w.acquire()
+    assert t1 is not None and t2 is not None
+    # if slots were per-chip, 8-wide dispatches would leave 14 "free"
+    assert w.acquire(timeout=0.05) is None
+    rep = w.report()
+    assert rep["window"] == 2
+    assert rep["devices"] == 8
+    assert rep["in_flight"] == 2
+    w.release(t1)
+    w.release(t2)
+    assert w.idle()
+
+
+def test_mesh_filter_window_reports_mesh_devices():
+    """A windowed mesh filter's transfer_report carries the mesh span,
+    and the dispatch/complete split stays correct: every frame settles
+    through the window with byte parity intact."""
+    x = np.random.RandomState(11).randn(8, 64).astype(np.float32)
+    ref = _open_model("zoo://mlp?dtype=float32")
+    want = np.asarray(ref.invoke([x])[0])
+    ref.close()
+    p = parse_launch(
+        f'appsrc name=in caps="{CAPS8x64}" '
+        '! tensor_filter name=f framework=jax '
+        'model=zoo://mlp?dtype=float32 custom=mesh:8x1x1 in-flight=2 '
+        '! appsink name=out')
+    p.start()
+    for _ in range(4):
+        p["in"].push_buffer(Buffer.from_arrays([x]))
+    p["in"].end_stream()
+    assert p.wait_eos(timeout=120)
+    rep = p["f"].transfer_report()
+    got = _sink_bytes(p)
+    p.stop()
+    assert rep["devices"] == 8
+    assert rep["window"] == 2
+    assert rep["completed"] == 4
+    assert len(got) == 4
+    assert all(g[0][2] == want.tobytes() for g in got)
