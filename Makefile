@@ -10,7 +10,8 @@ LIB := $(BUILD)/libnnstpu.so
 EXAMPLES := $(BUILD)/custom_passthrough.so $(BUILD)/custom_scaler.so
 
 .PHONY: native clean test check tier1 lint racecheck chaos chaos-zeroloss \
-	chaos-fleet chaos-preempt fuse-parity async-parity shard-parity package
+	chaos-fleet chaos-preempt fuse-parity async-parity shard-parity \
+	obs-overhead package
 
 native: $(LIB) $(EXAMPLES)
 
@@ -27,6 +28,7 @@ check: native lint racecheck
 	$(MAKE) chaos
 	$(MAKE) chaos-fleet
 	$(MAKE) chaos-preempt
+	$(MAKE) obs-overhead
 
 # `make fuse-parity` = the fusion compiler's byte-parity oracle: every
 # fusible pipeline in the corpus (plus a built-in representative suite)
@@ -79,6 +81,13 @@ chaos-fleet:
 # exactly.
 chaos-preempt:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py -q -m slow
+
+# `make obs-overhead` = the observability cost gate: the devres bench
+# row run with frame tracing on (NNS_TPU_OBS=1) vs hard-off, in
+# subprocesses, best-of-3 each — fails if the traced arm's fps is more
+# than 3% below the control (tools/obs_overhead.py).
+obs-overhead:
+	python tools/obs_overhead.py
 
 # `make tier1` = the exact ROADMAP.md tier-1 verify gate, verbatim
 # (timeout, log tee, pass-dot count and all).

@@ -20,6 +20,11 @@ Concurrency analysis (racecheck)::
 
     python -m nnstreamer_tpu racecheck nnstreamer_tpu/
     python -m nnstreamer_tpu racecheck --json -o build/racecheck.json
+
+Fleet telemetry (scrapes obs metrics endpoints into one table)::
+
+    python -m nnstreamer_tpu top --targets localhost:9100,localhost:9101
+    python -m nnstreamer_tpu top --broker localhost:5000 --watch 2
 """
 from __future__ import annotations
 
@@ -101,6 +106,9 @@ def main(argv=None) -> int:
     if argv and argv[0] == "racecheck":
         from .analysis.concurrency.cli import main as racecheck_main
         return racecheck_main(argv[1:])
+    if argv and argv[0] == "top":
+        from .obs.top import main as top_main
+        return top_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="python -m nnstreamer_tpu",
         description="Launch a tensor pipeline (gst-launch analog).")

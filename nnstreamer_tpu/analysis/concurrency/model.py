@@ -49,6 +49,7 @@ WORKER = "worker"            # scheduler/batcher flush threads
 DISPATCHER = "dispatcher"    # overlap window: chain-side frame dispatch
 COMPLETER = "completer"      # overlap window: per-element completer
 UPLOADER = "uploader"        # coalescing H2D upload service thread
+SCRAPER = "scraper"          # obs metrics endpoint serve/handle threads
 INIT = "init"                # quiescent lifecycle (dropped in locksets)
 
 # (ancestor class, method name) -> role: known entry points. Applied to
@@ -73,6 +74,10 @@ DEFAULT_SEEDS: List[Tuple[str, str, str]] = [
     ("FusedSegment", "_complete_error", COMPLETER),
     # bidirectional transfer service (tensors/transfer.py)
     ("_Uploader", "_run", UPLOADER),
+    # obs telemetry plane (obs/server.py): the pull endpoint's accept
+    # loop + per-request handlers run off the pipeline threads entirely
+    ("MetricsServer", "_serve_loop", SCRAPER),
+    ("MetricsServer", "_handle", SCRAPER),
 ]
 
 # methods whose accesses are ordered by the pipeline lifecycle

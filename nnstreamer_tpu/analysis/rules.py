@@ -831,6 +831,42 @@ class StatefulNoCheckpointRule(Rule):
                     f"is disposable", e.name)
 
 
+class TraceExportRule(Rule):
+    """A source with ``trace-export=true`` promises frame-level trace
+    continuity — but the trace context rides in buffer extras, and an
+    element that mints fresh output buffers (``STRIPS_META``) drops it.
+    Downstream spans then fall back to same-thread inheritance (fine
+    inside one streaming thread) and the WIRE loses the context
+    entirely: the remote half of the span tree detaches. WARN naming
+    the first stripping element on each path."""
+
+    id = "trace-export-stripped"
+    severity = Severity.WARNING
+
+    def check(self, ctx: LintContext):
+        for src in ctx.elements:
+            if not isinstance(src, SrcElement) \
+                    or not bool(getattr(src, "trace_export", False)):
+                continue
+            seen: Set[str] = set()
+            stack = list(ctx.downstream(src))
+            while stack:
+                e = stack.pop()
+                if e.name in seen:
+                    continue
+                seen.add(e.name)
+                if getattr(type(e), "STRIPS_META", False):
+                    yield self.finding(
+                        f"source '{src.name}' exports trace context but "
+                        f"{kind_of(e)} '{e.name}' mints fresh buffers "
+                        f"(STRIPS_META): frame spans past it lose their "
+                        f"trace ids on wire hops; move the element "
+                        f"upstream of the source stamp or accept "
+                        f"same-thread-only spans", e.name)
+                    continue  # report the FIRST stripper per path
+                stack.extend(ctx.downstream(e))
+
+
 ALL_RULES: List[Rule] = [
     DanglingPadRule(), CycleRule(), TeeNoQueueRule(), JitSignatureRule(),
     ShardingRule(), ServeMeshRule(), MeshColocationRule(),
@@ -839,7 +875,7 @@ ALL_RULES: List[Rule] = [
     WireConfigRule(), FusionBreakRule(), FusionTransferRule(),
     SessionReplayBudgetRule(), SessionNoReconnectRule(),
     RouterNoReplicasRule(), RouterAffinitySessionlessRule(),
-    AsyncWindowRule(), StatefulNoCheckpointRule(),
+    AsyncWindowRule(), StatefulNoCheckpointRule(), TraceExportRule(),
 ]
 
 

@@ -41,6 +41,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..obs import context as _obs_ctx
+from ..obs import spans as _obs_spans
 from ..tensors.buffer import Buffer, BufferFlags, Chunk
 from . import protocol
 from .protocol import Payload, as_payload_view, resolve_dtype
@@ -84,6 +86,14 @@ PROBE_BYTES = 16384
 # seq i64 (-1 = none), pts f64 (NaN = none), duration f64 (NaN = none),
 # flags u32 — replaces per-frame JSON meta
 _FHDR = struct.Struct("<qddI")
+# the trace-extended header (negotiated: both peers advertised
+# ``trace``; marked ``fhdr=2`` in the batch meta so the receiver is
+# self-describing): the v1 fields + trace_id u64, span_id u64 (0/0 =
+# untraced frame), then the context's birth stamp and queue/compute/
+# wire attribution accumulators (i64 ns each) so end-to-end latency
+# attribution survives the hop. A link that did not negotiate trace
+# ships the v1 header byte-identically.
+_FHDR_T = struct.Struct("<qddIQQqqqq")
 
 
 class WireConfig:
@@ -91,22 +101,30 @@ class WireConfig:
     state). One instance per connection; the skip counters are touched
     from whatever thread packs for that link, under a leaf lock."""
 
-    __slots__ = ("version", "codec", "precision", "_lock", "_poor", "_skip")
+    __slots__ = ("version", "codec", "precision", "trace", "_lock",
+                 "_poor", "_skip")
 
     def __init__(self, codec: str = CODEC_RAW, precision: str = PREC_NONE,
-                 version: int = WIRE_VERSION):
+                 version: int = WIRE_VERSION, trace: bool = False):
         import threading
         self.version = version
         self.codec = codec if codec in CODECS else CODEC_RAW
         self.precision = precision if precision in PRECISIONS else PREC_NONE
+        # negotiated frame-trace propagation (obs/): DATA meta gains a
+        # "trace" field and DATA_BATCH the fhdr=2 extended header —
+        # only when BOTH peers advertised it (old peers: byte-identical)
+        self.trace = bool(trace)
         self._lock = threading.Lock()
         self._poor = 0
         self._skip = 0
 
     def to_meta(self) -> Dict:
-        return {"v": self.version, "codec": self.codec,
-                "precision": self.precision, "codecs": list(CODECS),
-                "precisions": list(PRECISIONS)}
+        out = {"v": self.version, "codec": self.codec,
+               "precision": self.precision, "codecs": list(CODECS),
+               "precisions": list(PRECISIONS)}
+        if self.trace:
+            out["trace"] = True
+        return out
 
     # -- adaptive skip (incompressible streams stop paying for zlib) ---
     def _try_compress(self) -> bool:
@@ -137,8 +155,13 @@ class WireConfig:
 def advertise(codec: str = CODEC_RAW, precision: str = PREC_NONE) -> Dict:
     """The ``wire`` block a connecting peer puts in its handshake meta:
     what it supports, plus what it would like for this link."""
-    return {"v": WIRE_VERSION, "codec": codec, "precision": precision,
-            "codecs": list(CODECS), "precisions": list(PRECISIONS)}
+    out = {"v": WIRE_VERSION, "codec": codec, "precision": precision,
+           "codecs": list(CODECS), "precisions": list(PRECISIONS)}
+    if _obs_spans.ENABLED:
+        # frame-trace propagation support (an old peer just ignores the
+        # key; it only takes effect when both ends advertise it)
+        out["trace"] = True
+    return out
 
 
 def negotiate(peer: Optional[Dict], codec: str = CODEC_RAW,
@@ -163,7 +186,8 @@ def negotiate(peer: Optional[Dict], codec: str = CODEC_RAW,
         else str(peer.get("precision") or PREC_NONE)
     chosenp = wantp if wantp in PRECISIONS and wantp in peer_precs \
         else PREC_NONE
-    return WireConfig(chosen, chosenp)
+    return WireConfig(chosen, chosenp,
+                      trace=bool(peer.get("trace")) and _obs_spans.ENABLED)
 
 
 def accept(reply: Optional[Dict]) -> Optional[WireConfig]:
@@ -178,7 +202,8 @@ def accept(reply: Optional[Dict]) -> Optional[WireConfig]:
     except (TypeError, ValueError):
         return None
     return WireConfig(str(reply.get("codec") or CODEC_RAW),
-                      str(reply.get("precision") or PREC_NONE))
+                      str(reply.get("precision") or PREC_NONE),
+                      trace=bool(reply.get("trace")) and _obs_spans.ENABLED)
 
 
 def tune_socket(sock, bufsize: int = 1 << 20) -> None:
@@ -300,6 +325,10 @@ def pack_buffer(buf: Buffer, cfg: Optional[WireConfig] = None, stats=None
         nraw += raw_b
         nenc += len(payload)
     meta = {"pts": buf.pts, "duration": buf.duration, "tensors": tensors}
+    if cfg is not None and cfg.trace:
+        ctx = buf.extras.get(_obs_ctx.CTX_KEY)
+        if ctx is not None:
+            meta["trace"] = _obs_ctx.to_wire(ctx)
     if stats is not None:
         stats.add(wire_frames_out=1, wire_raw_bytes_out=nraw,
                   wire_enc_bytes_out=nenc,
@@ -315,9 +344,32 @@ def unpack_buffer(meta: Dict, payloads: Sequence[Payload], stats=None
         stats.inc("wire_frames_in")
     tensors = meta.get("tensors", [])
     if not any("codec" in t or "wire_dtype" in t for t in tensors):
-        return protocol.wire_to_buffer(meta, payloads)
-    chunks = [Chunk(_decode_tensor(t, p)) for t, p in zip(tensors, payloads)]
-    return Buffer(chunks, pts=meta.get("pts"), duration=meta.get("duration"))
+        buf = protocol.wire_to_buffer(meta, payloads)
+    else:
+        chunks = [Chunk(_decode_tensor(t, p))
+                  for t, p in zip(tensors, payloads)]
+        buf = Buffer(chunks, pts=meta.get("pts"),
+                     duration=meta.get("duration"))
+    trace = meta.get("trace")
+    if trace is not None and _obs_spans.ENABLED:
+        _adopt_trace(buf, trace)
+    return buf
+
+
+def _adopt_trace(buf: Buffer, field) -> None:
+    """Receiver side of a traced DATA frame: rebuild the context, record
+    the wire-hop span (parented on the sender's last span — the ids are
+    fleet-unique, so the merged dump re-links across processes), and
+    attribute the transit time."""
+    got = _obs_ctx.from_wire(field)
+    if got is None:
+        return
+    ctx, t_send = got
+    now = time.time_ns()
+    dur = max(0, now - t_send)
+    _obs_spans.record_span("wire", "wire", t_send, dur, ctx)
+    ctx.w_ns += dur
+    _obs_ctx.attach(buf, ctx)
 
 
 def batch_compatible(a: Buffer, b: Buffer) -> bool:
@@ -340,7 +392,9 @@ def pack_batch(bufs: Sequence[Buffer], cfg: Optional[WireConfig] = None,
     numeric ``enc`` codec list. Only ever sent on links that negotiated
     v2 (a v1 peer cannot parse DATA_BATCH)."""
     t0 = time.perf_counter_ns()
-    hdr = bytearray(_FHDR.size * len(bufs))
+    trace = cfg is not None and cfg.trace and _obs_spans.ENABLED
+    fhdr = _FHDR_T if trace else _FHDR
+    hdr = bytearray(fhdr.size * len(bufs))
     template: List[Dict] = []
     enc: List[int] = []
     payloads: List[Payload] = [hdr]
@@ -349,8 +403,19 @@ def pack_batch(bufs: Sequence[Buffer], cfg: Optional[WireConfig] = None,
         seq = seqs[i] if seqs is not None and seqs[i] is not None else -1
         pts = float("nan") if buf.pts is None else float(buf.pts)
         dur = float("nan") if buf.duration is None else float(buf.duration)
-        _FHDR.pack_into(hdr, i * _FHDR.size, int(seq), pts, dur,
-                        int(buf.flags))
+        if trace:
+            ctx = buf.extras.get(_obs_ctx.CTX_KEY)
+            if ctx is None:
+                _FHDR_T.pack_into(hdr, i * _FHDR_T.size, int(seq), pts,
+                                  dur, int(buf.flags), 0, 0, 0, 0, 0, 0)
+            else:
+                _FHDR_T.pack_into(hdr, i * _FHDR_T.size, int(seq), pts,
+                                  dur, int(buf.flags), ctx.trace_id,
+                                  ctx.span_id, ctx.t0_ns, ctx.q_ns,
+                                  ctx.c_ns, ctx.w_ns)
+        else:
+            _FHDR.pack_into(hdr, i * _FHDR.size, int(seq), pts, dur,
+                            int(buf.flags))
         for c in buf.chunks:
             payload, t, raw_b, code = _encode_tensor(np.asarray(c.host()),
                                                      cfg)
@@ -362,6 +427,9 @@ def pack_batch(bufs: Sequence[Buffer], cfg: Optional[WireConfig] = None,
             nenc += len(payload)
     meta = {"wire_batch": 1, "frames": len(bufs), "tensors": template,
             "enc": enc}
+    if trace:
+        meta["fhdr"] = 2
+        meta["ts"] = time.time_ns()   # one send stamp for the batch
     if stats is not None:
         stats.add(wire_frames_out=len(bufs), wire_raw_bytes_out=nraw,
                   wire_enc_bytes_out=nenc,
@@ -379,12 +447,16 @@ def unpack_batch(meta: Dict, payloads: Sequence[Payload], stats=None
     enc = meta.get("enc")
     ntens = len(template)
     hdr = payloads[0]
+    traced = int(meta.get("fhdr", 1)) >= 2
+    fhdr = _FHDR_T if traced else _FHDR
+    t_send = int(meta.get("ts", 0))
     if stats is not None:
         stats.add(wire_frames_in=frames)
     out: List[Buffer] = []
     idx = 1
     for i in range(frames):
-        seq, pts, dur, flags = _FHDR.unpack_from(hdr, i * _FHDR.size)
+        rec = fhdr.unpack_from(hdr, i * fhdr.size)
+        seq, pts, dur, flags = rec[:4]
         chunks = []
         for j, t in enumerate(template):
             code = enc[i * ntens + j] if enc else _CODE_RAW
@@ -396,5 +468,8 @@ def unpack_batch(meta: Dict, payloads: Sequence[Payload], stats=None
                      flags=BufferFlags(flags))
         if seq >= 0:
             buf.extras["seq"] = seq
+        if traced and _obs_spans.ENABLED and rec[4]:
+            _adopt_trace(buf, (rec[4], rec[5], t_send,
+                               rec[6], rec[7], rec[8], rec[9]))
         out.append(buf)
     return out

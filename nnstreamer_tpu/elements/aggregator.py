@@ -23,6 +23,7 @@ from ..tensors.info import TensorInfo, TensorsConfig, TensorsInfo
 class TensorAggregator(TransformElement):
     PROPS = {"frames-in": 1, "frames-out": 1, "frames-flush": 0,
              "frames-dim": 3, "concat": True, "silent": True}
+    STRIPS_META = True  # output windows are fresh buffers, N inputs -> 1
     RESTART_SAFE = False  # a restart would drop the aggregation window
     CHECKPOINTABLE = "the partial aggregation window (frames + timing)"
 

@@ -26,6 +26,7 @@ from .media import _VIDEO_CHANNELS
 class TensorConverter(TransformElement):
     SINK_TEMPLATES = {"sink": None}
     SRC_TEMPLATES = {"src": "other/tensors"}
+    STRIPS_META = True  # mints fresh tensor buffers from media frames
     PROPS = {
         "frames-per-tensor": 1,
         "input-dim": "",     # required for octet / text streams

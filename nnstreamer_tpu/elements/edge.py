@@ -33,6 +33,7 @@ from typing import Dict, List, Optional
 from ..edge import session as sess_mod
 from ..edge import wire
 from ..edge.protocol import MsgKind, recv_msg, send_msg, sever_socket as _sever
+from ..obs import events as _obs_events
 from ..pipeline.element import SinkElement, SrcElement
 from ..pipeline.pad import Pad
 from ..pipeline.registry import register_element
@@ -262,6 +263,9 @@ class EdgeSink(SinkElement):
                     detail="replay ring evicted part of the resume gap")
             if resumed:
                 self.stats.inc("session_resumes")
+                _obs_events.emit("resume", source=self.name, element=self,
+                                 session=scfg.sid[:8],
+                                 replayed=len(replay), lost=lost)
             with sub.lock:
                 send_msg(conn, MsgKind.RESUME_ACK,
                          {"sid": scfg.sid, "resumed": resumed,

@@ -30,6 +30,7 @@ from ..tensors.transfer import submit_fetch
 from ..tensors.caps import Caps
 from ..tensors.info import TensorInfo, TensorsConfig, TensorsInfo
 from ..tensors.types import TensorFormat
+from ..obs import events as _obs_events
 from ..pipeline.element import Element, TransferError
 from ..pipeline.events import Event, QosEvent
 from ..pipeline.pad import Pad
@@ -68,6 +69,8 @@ def infer_batch_dim(sel: TensorsInfo, model: TensorsInfo) -> Optional[int]:
 class TensorFilter(Element):
     SINK_TEMPLATES = {"sink": "other/tensors"}
     SRC_TEMPLATES = {"src": "other/tensors"}
+    # under overlap-depth>0 the executor adds dispatch/complete spans
+    SPAN_POINTS = ("chain", "dispatch", "complete")
     PROPS = {
         "framework": "auto",
         "model": "",
@@ -730,6 +733,8 @@ class TensorFilter(Element):
         hint so sources stop producing doomed frames."""
         self.stats.inc("shed")
         self.stats.inc("dropped")
+        _obs_events.emit("shed", source=self.name, element=self,
+                         reason="breaker-open", pts=buf.pts)
         retry_after_ms = float(self.breaker_retry_after_ms)
         rows = buf.extras.get("serve_rows")
         if rows:
@@ -750,6 +755,8 @@ class TensorFilter(Element):
         if new == OPEN:
             self.stats.inc("breaker_opened")
         logger.warning("%s: circuit breaker %s -> %s", self.name, old, new)
+        _obs_events.emit("breaker", source=self.name, element=self,
+                         old=old, new=new)
         self.post_message("warning", breaker=new, breaker_from=old,
                           invoke_errors=self.stats["invoke_errors"],
                           retry_after_ms=float(self.breaker_retry_after_ms))

@@ -1,0 +1,45 @@
+"""Frame-level observability: spans, the flight recorder, and the
+telemetry plane (≙ the reference's GstTracer latency/stats hooks plus
+the debug-category layer, grown into a fleet-wide plane).
+
+Three always-on layers, cheap enough to never turn off:
+
+* **frame spans** (`context.py` + `spans.py`) — every source stamps a
+  :class:`~.context.TraceContext` into ``Buffer.extras``; every element
+  hop, queue wait, wire hop, overlap dispatch/completion, and serve
+  batch records a span into a bounded per-thread ring. Wire hops carry
+  the context in DATA meta / the DATA_BATCH per-frame header, but only
+  on links that negotiated it (wire-v2 style) — old peers see
+  byte-identical traffic.
+* **flight recorder** (`recorder.py` + `events.py`) — the last N
+  seconds of spans plus structured events (shed, breaker flips,
+  failover, RESUME, preemption), dumped to Chrome ``trace_event`` JSON
+  on demand, on ``Pipeline.preempt()``, and on any abort.
+* **telemetry plane** (`metrics.py` + `server.py` + `top.py`) — a pull
+  endpoint per process serving Prometheus-style text exposition of the
+  runtime's counters/reservoirs plus end-to-end latency histograms
+  with queue/compute/wire attribution, and ``python -m nnstreamer_tpu
+  top`` to scrape a fleet into one table.
+
+``NNS_TPU_OBS=0`` disables recording entirely (the overhead gate's
+control arm); everything else defaults on.
+"""
+from __future__ import annotations
+
+from . import events  # noqa: F401  (re-export: obs.events.emit)
+from .context import (CTX_KEY, TraceContext, ctx_of, ensure_ctx,  # noqa: F401
+                      stamp)
+from .recorder import RECORDER, FlightRecorder  # noqa: F401
+from .spans import enabled, record_span, set_enabled  # noqa: F401
+
+
+def serve_metrics(port: int = 0, host: str = "127.0.0.1",
+                  broker: object = None, topic: str = "obs",
+                  labels: dict = None):
+    """Start this process's telemetry pull endpoint (lazy import so the
+    hot span path never pays for the server module)."""
+    from .server import MetricsServer
+    srv = MetricsServer(port=port, host=host, broker=broker, topic=topic,
+                        labels=labels)
+    srv.start()
+    return srv

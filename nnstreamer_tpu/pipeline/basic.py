@@ -6,8 +6,11 @@ from __future__ import annotations
 
 import queue as _pyqueue
 import threading
+import time
 from typing import Callable, List, Optional
 
+from ..obs import context as _obs_ctx
+from ..obs import spans as _obs_spans
 from ..tensors.buffer import Buffer, Chunk
 from ..tensors.caps import Caps
 from ..utils.log import logger
@@ -71,6 +74,7 @@ class Queue(Element):
     SINK_TEMPLATES = {"sink": None}
     SRC_TEMPLATES = {"src": None}
     PROPS = {"max-size-buffers": 16, "leaky": "none", "backend": "auto"}
+    SPAN_POINTS = ("queue-wait",)
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
@@ -156,6 +160,10 @@ class Queue(Element):
         tracer = getattr(self.pipeline, "tracer", None)
         if tracer is not None:
             tracer.record(self, item)
+        if _obs_spans.ENABLED:
+            # entry stamp: the worker's pop turns it into the
+            # queue-wait span (+ queue attribution on the context)
+            item.extras[_obs_ctx.QT_KEY] = time.time_ns()
         if self.leaky == "upstream":
             # GStreamer leaky=upstream: drop the incoming buffer when full
             try:
@@ -204,6 +212,15 @@ class Queue(Element):
                         self.forward_event(item)
                 else:
                     self.stats.add(buffers=1, bytes=item.nbytes)
+                    if _obs_spans.ENABLED:
+                        qt = item.extras.pop(_obs_ctx.QT_KEY, None)
+                        if qt is not None:
+                            ctx = item.extras.get(_obs_ctx.CTX_KEY)
+                            if ctx is not None:
+                                wait = max(0, time.time_ns() - qt)
+                                _obs_spans.record_span(self.name, "queue",
+                                                       qt, wait, ctx)
+                                ctx.q_ns += wait
                     self.srcpad.push(item)
             except FlowError:
                 break

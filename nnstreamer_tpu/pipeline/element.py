@@ -16,6 +16,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Union
 
+from ..obs import context as _obs_ctx
+from ..obs import spans as _obs_spans
 from ..tensors.buffer import Buffer
 from ..tensors.caps import Caps
 from ..utils.atomic import Counters
@@ -68,6 +70,16 @@ class Element:
     # elements opting into on_error=restart declare that stop()/start()
     # rebuilds them losslessly (pipelint errors on restart otherwise)
     RESTART_SAFE = False
+    # per-element observability span points (Documentation/observability
+    # .md; gen_element_docs.py emits these per element): where this
+    # element records frame spans into the flight recorder
+    SPAN_POINTS = ("chain",)
+    # elements that mint FRESH output buffers without copying the input
+    # buffer's extras declare it: the trace context then survives only
+    # through same-thread inheritance, and pipelint's trace-export rule
+    # warns when such an element sits between a trace-exporting source
+    # and a wire hop (analysis/rules.py TraceExportRule)
+    STRIPS_META = False
 
     _anon_counter = [0]
 
@@ -186,6 +198,7 @@ class Element:
         tracer = getattr(self.pipeline, "tracer", None)
         if tracer is not None:
             tracer.record(self, item)
+        t_wall = time.time_ns() if _obs_spans.ENABLED else 0
         t0 = time.perf_counter_ns()
         try:
             self.do_chain(pad, item)
@@ -201,6 +214,9 @@ class Element:
         dt = time.perf_counter_ns() - t0
         # one lock round-trip for the whole per-buffer bump
         self.stats.add(buffers=1, bytes=item.nbytes, proctime_ns=dt)
+        if _obs_spans.ENABLED:
+            # per-hop frame span into the per-thread ring (obs/spans.py)
+            _obs_spans.chain_span(self, item, t_wall, dt)
 
     def do_chain(self, pad: Pad, buf: Buffer) -> None:
         raise NotImplementedError
@@ -448,10 +464,15 @@ class SrcElement(Element):
     """
 
     SRC_TEMPLATES = {"src": None}
-    PROPS = {"num-buffers": -1}
+    # trace-export declares INTENT that this source's frame traces
+    # survive to the sinks and across wire hops (pipelint's
+    # TraceExportRule checks nothing downstream strips the context);
+    # recording itself is always on (obs/, NNS_TPU_OBS=0 to disable)
+    PROPS = {"num-buffers": -1, "trace-export": False}
     # restart for a source is a loop-level stream replay (on_restart
     # hook + preamble), which every source supports by construction
     RESTART_SAFE = True
+    SPAN_POINTS = ("source-root", "chain")
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
@@ -577,6 +598,10 @@ class SrcElement(Element):
             tracer = getattr(self.pipeline, "tracer", None)
             if tracer is not None:
                 tracer.stamp(buf)
+            if _obs_spans.ENABLED and _obs_ctx.ctx_of(buf) is None:
+                # root of this frame's span tree (a source that already
+                # attached a context — serve batch adoption — keeps it)
+                _obs_spans.record_root(self.name, _obs_ctx.stamp(buf))
             self.srcpad.push(buf)
             self._pushed += 1
         self.srcpad.push(EosEvent())

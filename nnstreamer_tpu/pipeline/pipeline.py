@@ -103,10 +103,20 @@ class Pipeline:
     # -- messages ---------------------------------------------------------
     def post_message(self, kind: str, **data) -> None:
         if kind == "error":
+            first = False
             with self._lock:
                 if self._error is None:
                     self._error = data.get("error")
+                    first = True
             self._eos_evt.set()  # unblock waiters
+            if first:
+                # black-box: any abort records the event and dumps the
+                # last-N-seconds flight recording (rate-limited)
+                from ..obs import events as _obs_events
+                from ..obs.recorder import RECORDER
+                _obs_events.emit("abort", source=self.name, level=10,
+                                 error=repr(data.get("error")))
+                RECORDER.dump_abort(f"{self.name}-abort")
         self.bus.post(Message(kind, data))
 
     def _sink_eos(self, sink: Element) -> None:
@@ -160,6 +170,8 @@ class Pipeline:
         for e in srcs:
             e.start()
         self.running = True
+        from ..obs import metrics as _obs_metrics
+        _obs_metrics.register_pipeline(self)
         return self
 
     def stop(self) -> "Pipeline":
@@ -170,6 +182,8 @@ class Pipeline:
             if not isinstance(e, SrcElement):
                 e.stop()
         self.running = False
+        from ..obs import metrics as _obs_metrics
+        _obs_metrics.unregister_pipeline(self)
         return self
 
     def drain(self, deadline: float = 10.0) -> bool:
@@ -183,6 +197,9 @@ class Pipeline:
         Safe to call twice; a drain of a never-started pipeline just
         stops it."""
         t0 = time.monotonic()
+        from ..obs import events as _obs_events
+        _obs_events.emit("drain", source=self.name, level=20,
+                         deadline_s=float(deadline))
         self.post_message("drain", deadline=deadline)
         for e in self.elements.values():
             try:
@@ -292,6 +309,14 @@ class Pipeline:
         Returns ``{"snapshot", "drained", "abandoned", "grace_s",
         "used_s"}``."""
         t0 = time.monotonic()
+        from ..obs import events as _obs_events
+        from ..obs.recorder import RECORDER
+        _obs_events.emit("preempt", source=self.name,
+                         grace_s=float(grace_s))
+        # the black-box dump is deliberate here (force past the abort
+        # rate limit): a preemption is the canonical "what was the
+        # fleet doing in its last seconds" question
+        RECORDER.dump_abort(f"{self.name}-preempt", force=True)
         self.post_message("preempt", grace_s=grace_s)
         for e in self.elements.values():
             try:

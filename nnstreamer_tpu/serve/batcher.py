@@ -26,6 +26,7 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..obs import events as _obs_events
 from ..utils.atomic import Counters
 
 
@@ -38,13 +39,14 @@ class Request:
     """
 
     __slots__ = ("stream_id", "seq", "arrays", "pts", "deadline",
-                 "on_result", "on_shed", "t_arrival", "t_batched")
+                 "on_result", "on_shed", "t_arrival", "t_batched", "ctx")
 
     def __init__(self, stream_id: Any, arrays: Sequence[Any], *,
                  seq: Optional[int] = None, pts: Optional[int] = None,
                  deadline: Optional[float] = None,
                  on_result: Optional[Callable] = None,
-                 on_shed: Optional[Callable] = None):
+                 on_shed: Optional[Callable] = None,
+                 ctx: Optional[Any] = None):
         self.stream_id = stream_id
         self.arrays = [np.asarray(a) for a in arrays]
         self.seq = seq
@@ -54,6 +56,7 @@ class Request:
         self.on_shed = on_shed            # (request) -> None
         self.t_arrival = time.monotonic()
         self.t_batched: Optional[float] = None
+        self.ctx = ctx                    # obs TraceContext riding the frame
 
     def signature(self):
         return tuple((a.shape, a.dtype.str) for a in self.arrays)
@@ -220,6 +223,9 @@ class BucketBatcher:
                         timeout = min(timeout, nearest - now)
                     self._cond.wait(timeout=max(0.0, min(timeout, poll_s)))
         finally:
+            if shed:
+                _obs_events.emit("shed", source="batcher",
+                                 reason="deadline", frames=len(shed))
             for r in shed:
                 if r.on_shed is not None:
                     r.on_shed(r)

@@ -23,6 +23,8 @@ from typing import Dict, Optional, Tuple
 
 from ..edge import wire
 from ..edge.protocol import MsgKind, recv_msg, send_msg, sever_socket as _sever
+from ..obs import context as _obs_ctx
+from ..obs import events as _obs_events
 from ..pipeline.element import SinkElement, SrcElement
 from ..pipeline.pad import Pad
 from ..pipeline.registry import register_element
@@ -77,6 +79,9 @@ class TensorServeSrc(SrcElement):
              # batch is device_put batch-major across the mesh before
              # dispatch — one sharded invoke per batch. "" = per-chip.
              "mesh": ""}
+
+    # the scheduler records queue_wait + batch spans on the request ctx
+    SPAN_POINTS = ("queue-wait", "batch", "chain")
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
@@ -274,7 +279,8 @@ class TensorServeSrc(SrcElement):
         self.scheduler.submit(
             cid, [c.host() for c in buf.chunks],
             seq=seq, pts=buf.pts,
-            on_result=self._on_result, on_shed=self._on_shed)
+            on_result=self._on_result, on_shed=self._on_shed,
+            ctx=_obs_ctx.ctx_of(buf))
 
     # -- reply side (called by the scheduler's demux) ----------------------
     def _on_result(self, req: Request, row) -> None:
@@ -283,9 +289,12 @@ class TensorServeSrc(SrcElement):
         with self._clock:
             entry = self._conns.get(req.stream_id)
         cfg = entry[2] if entry is not None else None
-        meta, payloads = wire.pack_buffer(
-            Buffer.from_arrays(list(row), pts=req.pts), cfg,
-            stats=self.stats)
+        reply = Buffer.from_arrays(list(row), pts=req.pts)
+        if req.ctx is not None:
+            # the reply carries the request's trace context home so the
+            # client-side sink attributes the whole journey
+            _obs_ctx.attach(reply, req.ctx)
+        meta, payloads = wire.pack_buffer(reply, cfg, stats=self.stats)
         meta["client_id"] = req.stream_id
         meta["seq"] = req.seq
         self._send(req.stream_id, MsgKind.RESULT, meta, payloads)
@@ -330,6 +339,8 @@ class TensorServeSrc(SrcElement):
         pending correlation is answered — RESULT or SHED — before the
         pipeline closes."""
         super().drain()
+        _obs_events.emit("drain", source=self.name, element=self,
+                         clients=len(self._conns))
         if self.scheduler is not None:
             self.scheduler.drain()
         with self._clock:
@@ -398,6 +409,11 @@ class TensorServeSrc(SrcElement):
         out.extras["serve_id"] = self.id
         # the filter slices padded HOST rows off before any D2H
         out.extras["batch_valid_rows"] = len(batch)
+        if batch[0].ctx is not None:
+            # batch adoption: the fused-segment spans downstream join the
+            # first request's trace tree (the other rows stay connected
+            # through their own queue_wait/batch spans)
+            _obs_ctx.attach(out, batch[0].ctx.child())
         return out
 
 

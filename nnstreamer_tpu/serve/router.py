@@ -54,6 +54,7 @@ from ..edge.listener import TcpListener
 from ..edge.protocol import MsgKind, recv_msg, send_msg, sever_socket as _sever
 from ..edge.session import Heartbeat
 from ..fault.breaker import CircuitBreaker
+from ..obs import events as _obs_events
 from ..pipeline.element import Element
 from ..pipeline.registry import register_element
 from ..tensors.buffer import Buffer
@@ -435,6 +436,8 @@ class FleetRouter:
 
     def _shed_to_client(self, cid: int, cseq, buf: Buffer) -> None:
         self.stats.inc("router_shed")
+        _obs_events.emit("shed", source=self.name, element=self,
+                         reason="no-replica", client=cid)
         self._send_client(cid, MsgKind.SHED,
                           {"seq": cseq, "pts": buf.pts, "client_id": cid,
                            "retry_after_ms": float(self.retry_after_ms)})
@@ -608,6 +611,8 @@ class FleetRouter:
         self.stats.inc("router_replica_deaths")
         _sever(sock)
         logger.warning("%s: replica %s died; failing over", self.name, key)
+        _obs_events.emit("failover", source=self.name, element=self,
+                         replica=key)
         self._failover(key)
         self._wake.set()  # immediate re-dial attempt + membership requery
 

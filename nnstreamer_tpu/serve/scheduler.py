@@ -25,6 +25,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..obs import events as _obs_events
+from ..obs import spans as _obs_spans
 from ..utils.atomic import Counters
 from ..utils.log import logger
 from ..utils.trace import Reservoir
@@ -89,16 +91,19 @@ class ServeScheduler:
                seq: Optional[int] = None, pts: Optional[int] = None,
                on_result: Optional[Callable] = None,
                on_shed: Optional[Callable] = None,
-               deadline_s: Optional[float] = None) -> bool:
+               deadline_s: Optional[float] = None,
+               ctx: Optional[Any] = None) -> bool:
         """Admit one request. False = shed at admission; the ``on_shed``
         callback has already been invoked (retry-after is the caller's
         wire-level answer)."""
         dl = self.deadline_s if deadline_s is None else deadline_s
         req = Request(stream_id, arrays, seq=seq, pts=pts,
                       deadline=(time.monotonic() + dl) if dl > 0 else None,
-                      on_result=on_result, on_shed=on_shed)
+                      on_result=on_result, on_shed=on_shed, ctx=ctx)
         if self.batcher.submit(req):
             return True
+        _obs_events.emit("shed", source=self.name, reason="admission",
+                         stream=str(stream_id))
         if on_shed is not None:
             on_shed(req)
         return False
@@ -163,6 +168,15 @@ class ServeScheduler:
             for r in batch:
                 self.tracer.observe(f"{self.name}:queue_delay",
                                     (now - r.t_arrival) * 1e9)
+        if _obs_spans.ENABLED:
+            t_wall = time.time_ns()
+            for r in batch:
+                if r.ctx is not None:
+                    wait = int((now - r.t_arrival) * 1e9)
+                    _obs_spans.record_span(f"{self.name}:queue_wait",
+                                           "queue", t_wall - wait, wait,
+                                           r.ctx)
+                    r.ctx.q_ns += wait
         return batch, bucket, self.place(stack_requests(batch, bucket))
 
     def place(self, stacked):
@@ -211,6 +225,11 @@ class ServeScheduler:
                     self._batch_latency.add(lat_ns)
                 if self.tracer is not None:
                     self.tracer.observe(f"{self.name}:batch_latency", lat_ns)
+                if _obs_spans.ENABLED and req.ctx is not None:
+                    dur = int(lat_ns)
+                    _obs_spans.record_span(f"{self.name}:batch", "compute",
+                                           time.time_ns() - dur, dur, req.ctx)
+                    req.ctx.c_ns += dur
             if req.on_result is None:
                 continue
             try:
@@ -305,6 +324,8 @@ class ServeScheduler:
                     self.stats.inc("invoke_errors")
                 logger.warning("%s: invoke failed (%r), batch of %d shed",
                                self.name, exc, len(batch), exc_info=True)
+                _obs_events.emit("shed", source=self.name, reason="invoke",
+                                 frames=len(batch))
                 for r in batch:
                     if r.on_shed is not None:
                         r.on_shed(r)

@@ -321,11 +321,11 @@ class Tracer:
             # window stats are per-MESH: a sharded in-flight frame is
             # one slot across every chip its program spans, so the
             # occupancy/blocked numbers must not be read per-chip —
-            # surface the widest span so the block is self-describing
+            # surface the widest span so the block is self-describing.
+            # Always present (1 = per-chip), matching the fusion block.
             spans = [int(w.get("devices", 1) or 1)
                      for w in windows.values()]
-            if spans and max(spans) > 1:
-                out["devices"] = max(spans)
+            out["devices"] = max(spans) if spans else 1
         try:
             from ..tensors.transfer import transfer_stats
             svc = transfer_stats()

@@ -570,3 +570,36 @@ class TestReport:
         p = parse_launch(f"tensortestsrc caps={CAPS_U8} ! appsink name=o")
         report = analyze(p, rules=[Broken()])
         assert report.findings == []
+
+
+class TestTraceExportRule:
+    def test_stripper_downstream_of_export_warns_naming_it(self):
+        got = findings_for(  # pipelint: skip — aggregator strips the ctx
+            f"tensortestsrc name=src caps={CAPS_U8} trace-export=true ! "
+            "tensor_aggregator name=agg ! fakesink",
+            "trace-export-stripped")
+        assert [(f.element, f.severity) for f in got] == \
+            [("agg", Severity.WARNING)]
+        assert "'src'" in got[0].message and "'agg'" in got[0].message
+        assert "STRIPS_META" in got[0].message
+
+    def test_only_first_stripper_per_path_is_reported(self):
+        got = findings_for(  # pipelint: skip — two strippers in a row
+            f"tensortestsrc caps={CAPS_U8} trace-export=true ! "
+            "tensor_aggregator name=a1 ! tensor_aggregator name=a2 ! "
+            "fakesink", "trace-export-stripped")
+        assert [f.element for f in got] == ["a1"]
+
+    def test_no_export_no_finding(self):
+        got = findings_for(
+            f"tensortestsrc caps={CAPS_U8} ! "
+            "tensor_aggregator name=agg ! fakesink",
+            "trace-export-stripped")
+        assert got == []
+
+    def test_export_with_meta_preserving_chain_is_clean(self):
+        got = findings_for(
+            f"tensortestsrc caps={CAPS_U8} trace-export=true ! queue ! "
+            "tensor_transform mode=typecast option=float32 ! fakesink",
+            "trace-export-stripped")
+        assert got == []

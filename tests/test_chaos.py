@@ -879,3 +879,72 @@ class TestZeroLossChaos:
         # the exact contiguous tail (frame value i rode seq i+1)
         tail = [float(b.chunks[0].host()[0]) for b in sub2["out"].buffers]
         assert tail == [float(i) for i in range(lost, total)]
+
+
+# ----------------------------------------- span-tree chaos (ISSUE 12)
+
+class TestSpanTreeChaos:
+    """Frame tracing under link chaos: seeded link kills with session
+    RESUME replay in flight must never leave a settled frame with a
+    broken span tree — every span's parent resolves within its trace
+    and each trace has exactly the one source root, replays included."""
+
+    def test_link_kills_leave_no_orphan_spans(self):
+        from nnstreamer_tpu.obs import context as obs_ctx
+        from nnstreamer_tpu.obs import spans as obs_spans
+
+        port = _free_port()
+        pub = parse_launch(
+            f'appsrc name=in caps="{SERVE_CAPS}" '
+            f'! edgesink name=p port={port} topic=t session=true '
+            'coalesce-frames=4 coalesce-ms=10')
+        pub.start()
+        time.sleep(0.2)
+        sub = parse_launch(
+            f'edgesrc name=s dest-port={port} topic=t session=true '
+            'ack-every=4 timeout=15 '
+            '! tensor_fault name=f mode=kill-link target=s every=10 seed=3 '
+            '! appsink name=out')
+        sub.start()
+        time.sleep(0.3)
+        n = 50
+        for i in range(n):
+            pub["in"].push_buffer(Buffer.from_arrays(
+                [np.full(4, float(i), np.float32)]))
+            time.sleep(0.01)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and \
+                len(sub["out"].buffers) < n:
+            time.sleep(0.05)
+        kills = sub["f"].stats["faults"]
+        bufs = list(sub["out"].buffers)
+        pub["in"].end_stream()
+        pub.wait_eos(timeout=10)
+        pub.stop()
+        sub.stop()
+        assert kills >= 3              # the chaos schedule actually fired
+        assert len(bufs) == n          # zero loss (the ISSUE 7 contract)
+        ctxs = [obs_ctx.ctx_of(b) for b in bufs]
+        assert all(c is not None for c in ctxs), \
+            "a settled frame lost its trace context across RESUME replay"
+        traces = {c.trace_id for c in ctxs}
+        assert len(traces) == n
+        by_trace = {t: [] for t in traces}
+        for _tid, s in obs_spans.snapshot():
+            if s[4] in by_trace:
+                by_trace[s[4]].append(s)
+        for ctx in ctxs:
+            spans = by_trace[ctx.trace_id]
+            ids = {s[5] for s in spans}
+            roots = [s for s in spans if s[6] == 0]
+            # exactly one root per frame: a replayed delivery re-links
+            # onto the SAME source stamp, it never mints a second tree
+            assert len(roots) == 1, \
+                f"trace {ctx.trace_id:#x}: {len(roots)} roots"
+            for s in spans:
+                assert s[6] == 0 or s[6] in ids, \
+                    f"orphan span {s} in trace {ctx.trace_id:#x}"
+            # the frame crossed the chaos link: a wire span is present
+            assert any(s[1] == "wire" for s in spans)
+            # and its settled context attributed the transit
+            assert ctx.w_ns > 0

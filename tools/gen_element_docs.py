@@ -81,6 +81,17 @@ def main() -> int:
         if ckpt:
             out.append(f"**Checkpointable (preemption snapshot):** {ckpt}")
             out.append("")
+        spts = getattr(cls, "SPAN_POINTS", None)
+        if spts:
+            out.append("**Frame-span points (flight recorder):** "
+                       + ", ".join(f"`{s}`" for s in spts))
+            out.append("")
+        if getattr(cls, "STRIPS_META", False):
+            out.append("**Strips buffer meta:** output buffers are minted "
+                       "fresh — the frame trace context survives only via "
+                       "same-thread inheritance (see pipelint's "
+                       "`trace-export-stripped` rule)")
+            out.append("")
         props = {}
         for klass in reversed(cls.__mro__):
             props.update(getattr(klass, "PROPS", {}))
