@@ -10,8 +10,8 @@ LIB := $(BUILD)/libnnstpu.so
 EXAMPLES := $(BUILD)/custom_passthrough.so $(BUILD)/custom_scaler.so
 
 .PHONY: native clean test check tier1 lint racecheck chaos chaos-zeroloss \
-	chaos-fleet chaos-preempt fuse-parity async-parity shard-parity \
-	obs-overhead package
+	chaos-fleet chaos-preempt chaos-llm fuse-parity async-parity \
+	shard-parity obs-overhead package
 
 native: $(LIB) $(EXAMPLES)
 
@@ -28,6 +28,7 @@ check: native lint racecheck
 	$(MAKE) chaos
 	$(MAKE) chaos-fleet
 	$(MAKE) chaos-preempt
+	$(MAKE) chaos-llm
 	$(MAKE) obs-overhead
 
 # `make fuse-parity` = the fusion compiler's byte-parity oracle: every
@@ -81,6 +82,14 @@ chaos-fleet:
 # exactly.
 chaos-preempt:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py -q -m slow
+
+# `make chaos-llm` = the disaggregated-LLM acceptance run (slow-marked,
+# excluded from tier-1): a decode replica is killed mid-stream after a
+# wire KV handoff; a fresh replica restores its snapshot and the
+# re-shipped prompt must resume with EXACT token continuity (zero
+# tokens lost or duplicated vs the monolithic greedy reference).
+chaos-llm:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_llm_disagg.py -q -m slow
 
 # `make obs-overhead` = the observability cost gate: the devres bench
 # row run with frame tracing on (NNS_TPU_OBS=1) vs hard-off, in

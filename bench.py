@@ -1289,6 +1289,181 @@ LLM_TOY = "zoo://gpt?vocab=8192&d_model=512&n_heads=8&n_layers=8"
 # weights read per shared decode step — the config where decode is
 # genuinely HBM-bandwidth-bound and MBU means something
 LLM_LARGE = "zoo://gpt?vocab=32000&d_model=1536&n_heads=16&n_layers=24"
+# disagg row model: big enough that a 64-token prefill visibly stalls
+# a decode loop, small enough that the row stays a few seconds
+LLM_DISAGG = "zoo://gpt?vocab=512&d_model=256&n_heads=8&n_layers=4"
+
+
+def _llm_disagg_prompts(n: int, plen: int, shared: int):
+    import numpy as np
+    base = (np.arange(plen, dtype=np.int32) % 500) + 1
+    out = []
+    for i in range(n):
+        p = base.copy()
+        p[shared:] = ((np.arange(plen - shared) * 7 + i * 31) % 500) + 1
+        out.append(p)
+    return out
+
+
+def bench_llm_disagg_row(n_sessions: int = 8, prompt_len: int = 64,
+                         max_tokens: int = 12) -> dict:
+    """Disaggregated LLM serving row (ISSUE 13), self-adjudicating.
+
+    Two claims, each measured against its own control arm on identical
+    prompts and budgets:
+
+    * **prefill/decode split** — 8 sessions through 1 prefill replica +
+      1 decode replica (wire KV handoff) vs 2 monolithic replicas x 4
+      sessions. The metric is decode-chip occupancy: tokens/s per chip
+      running a decode loop, first token -> last token. The monolithic
+      arm interleaves 4 long prompt passes into each chip's decode
+      window; the disagg decode chip runs zero (its
+      ``prefill_computed_tokens`` counter proves it) and serves ALL 8
+      sessions. Verdict "disaggregated" only when the lone decode chip
+      beats the per-chip monolithic rate by >= 1.2x.
+    * **content-addressed prefix cache** — the 8 prompts share their
+      first ~90%; prefill multiplication = prompt tokens admitted /
+      prompt tokens actually computed on a warm-cache paged replica.
+      Verdict "multiplied" when >= 2x (block-aligned sharing must beat
+      halving even after the alignment loss).
+
+    Deterministic admission/compute accounting + wall-clock windows on
+    the local backend — not weather-probed.
+    """
+    import numpy as np
+
+    from nnstreamer_tpu.filters.base import FilterProperties
+    from nnstreamer_tpu.filters.registry import find_filter
+
+    shared = int(prompt_len * 0.9)
+    prompts = _llm_disagg_prompts(n_sessions, prompt_len, shared)
+    total = n_sessions * max_tokens
+
+    def mk(custom):
+        f = find_filter("llm")()
+        f.open(FilterProperties(model_files=(LLM_DISAGG,),
+                                invoke_async=True,
+                                custom_properties=custom))
+        return f
+
+    def timed_window(filters, submit, warm, warm_tokens):
+        """warm each filter (waiting for ALL its warmup tokens so no
+        residual warm work lands in the window), then run ``submit``
+        and time first->last of ``total`` tokens."""
+        got = {"n": 0, "t0": None, "t1": None}
+        lk = threading.Lock()
+        done = threading.Event()
+        warm_evt = threading.Event()
+        warm_n = [0]
+
+        def dispatch(outputs, ctx=None):
+            if not warm_evt.is_set():
+                with lk:
+                    warm_n[0] += 1
+                    if warm_n[0] >= warm_tokens:
+                        warm_evt.set()
+                return
+            with lk:
+                got["n"] += 1
+                if got["t0"] is None:
+                    got["t0"] = time.perf_counter()
+                if got["n"] >= total:
+                    got["t1"] = time.perf_counter()
+                    done.set()
+
+        for f in filters:
+            f.set_async_dispatcher(dispatch)
+        warm()
+        if not warm_evt.wait(timeout=600):
+            raise RuntimeError("llm_disagg: warmup produced no tokens")
+        time.sleep(0.2)          # warmup slot frees; scheduler settles
+        submit()
+        if not done.wait(timeout=600):
+            raise RuntimeError(
+                f"llm_disagg: {got['n']}/{total} tokens delivered")
+        return got["t1"] - got["t0"]
+
+    cold = "prefix_cache:false,"
+    base = (f"max_tokens:{max_tokens},max_len:128,block_size:16,"
+            f"seed:5,")
+    warm_prompt = np.full(prompt_len, 501, np.int32)
+
+    # -- arm A: 2 monolithic replicas (prefill + decode on-chip) x 4
+    monos = [mk(base + cold + "n_parallel:4,paged:true")
+             for _ in range(2)]
+    try:
+        wall = timed_window(
+            monos,
+            submit=lambda: [monos[i % 2].invoke_async([p], ctx=i)
+                            for i, p in enumerate(prompts)],
+            warm=lambda: [m.invoke_async([warm_prompt], ctx="w")
+                          for m in monos],
+            warm_tokens=len(monos) * max_tokens)
+        mono_tok_s_chip = total / wall / len(monos)
+    finally:
+        for m in monos:
+            m.close()
+
+    # -- arm B: 1 prefill replica -> wire KV handoff -> 1 decode replica
+    dec = mk(base + cold + f"n_parallel:{n_sessions},role:decode,"
+             "handoff_port:0")
+    pre = mk(base + cold +
+             f"role:prefill,handoff:127.0.0.1:{dec.handoff_port}")
+    try:
+        wall = timed_window(
+            [dec],
+            submit=lambda: [pre.invoke_async([p], ctx=i)
+                            for i, p in enumerate(prompts)],
+            warm=lambda: pre.invoke_async([warm_prompt], ctx="w"),
+            warm_tokens=max_tokens)
+        disagg_tok_s = total / wall
+        decode_prefilled = int(dec.stats["prefill_computed_tokens"])
+        shipped = int(dec.stats["kv_shipped_tokens"])
+        handoffs = int(dec.stats["kv_handoffs_in"])
+        handoff_errors = int(pre.stats["kv_handoff_errors"])
+    finally:
+        pre.close()
+        dec.close()
+
+    # -- prefix-cache arm: same prompts on a warm content-addressed pool
+    fpx = mk(base + "n_parallel:4,paged:true,prefix_cache:true")
+    try:
+        timed_window(
+            [fpx],
+            submit=lambda: [fpx.invoke_async([p], ctx=i)
+                            for i, p in enumerate(prompts)],
+            warm=lambda: fpx.invoke_async([warm_prompt], ctx="w"),
+            warm_tokens=max_tokens)
+        snap = fpx.stats.snapshot()
+        # the warmup prompt is part of the ledger (all-cold: its token
+        # pattern shares no block chain with the measured prompts)
+        admitted = prompt_len * (n_sessions + 1)
+        computed = int(snap["prefill_computed_tokens"])
+        cached = int(snap["prefill_cached_tokens"])
+        mult = admitted / max(1, computed)
+        pool = fpx._pool_mgr.stats_dict()
+    finally:
+        fpx.close()
+
+    disagg_ok = (disagg_tok_s >= 1.2 * mono_tok_s_chip
+                 and decode_prefilled == 0 and handoff_errors == 0
+                 and handoffs >= n_sessions)
+    mult_ok = mult >= 2.0 and cached > 0
+    return {"llm_disagg": {
+        "sessions": n_sessions, "prompt_len": prompt_len,
+        "shared_prefix_len": shared, "max_tokens": max_tokens,
+        "mono_tok_s_per_chip": round(mono_tok_s_chip, 1),
+        "disagg_decode_tok_s_per_chip": round(disagg_tok_s, 1),
+        "disagg_vs_mono": round(disagg_tok_s / mono_tok_s_chip, 2),
+        "decode_prefill_tokens_computed": decode_prefilled,
+        "kv_shipped_tokens": shipped,
+        "kv_handoffs": handoffs, "kv_handoff_errors": handoff_errors,
+        "prefix_multiplication": round(mult, 2),
+        "prefix_cached_tokens": cached,
+        "prefix_hit_ratio": round(pool["prefix_hit_ratio"], 3),
+        "prefix_verdict": "multiplied" if mult_ok else "UNSHARED",
+        "verdict": "disaggregated" if disagg_ok else "MONOLITHIC-BOUND",
+    }}
 
 
 _SUMMARY_BUDGET = 1500  # bytes; the driver truncates longer stdout lines
@@ -1319,9 +1494,12 @@ def _compact_summary(result: dict) -> str:
         if k in top1:
             cex[k] = top1[k]
     for k in ("chaos_zeroloss", "fleet_failover", "async_overlap",
-              "sharded_serve"):
+              "sharded_serve", "llm_disagg"):
         if isinstance(ex.get(k), dict):
             cex[f"{k}_verdict"] = ex[k].get("verdict")
+    if isinstance(ex.get("llm_disagg"), dict):
+        cex["llm_prefix_multiplication"] = \
+            ex["llm_disagg"].get("prefix_multiplication")
     cex["configs"] = configs
     cex["detail"] = "BENCH_DETAIL.json"
     summary = {"metric": result["metric"], "value": result["value"],
@@ -1586,6 +1764,15 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001
         print(f"# sharded serve row failed: {e}", file=sys.stderr)
         extras["sharded_serve"] = None
+
+    # disaggregated-LLM row: prefill/decode split over wire KV handoff
+    # vs monolithic replicas, plus prefix-cache prefill multiplication
+    # (ISSUE 13). Deterministic admission ledgers; self-adjudicating.
+    try:
+        extras.update(bench_llm_disagg_row())
+    except Exception as e:  # noqa: BLE001
+        print(f"# llm disagg row failed: {e}", file=sys.stderr)
+        extras["llm_disagg"] = None
 
     # separate traced pass: tracer bookkeeping must not sit inside the
     # timed region of the fps row above. Long enough (120 frames vs ~40

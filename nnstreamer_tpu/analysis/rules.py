@@ -867,6 +867,69 @@ class TraceExportRule(Rule):
                 stack.extend(ctx.downstream(e))
 
 
+class LlmDecodeNoKvBudgetRule(Rule):
+    """A decode-role (or explicitly paged) llm filter without an
+    explicit ``pool_blocks`` budget sizes its KV pool from
+    n_parallel x max_len — the contiguous worst case. That defeats the
+    point of paging on a decode replica: admission is supposed to be
+    token-budgeted against a deliberately smaller arena (plus prefix
+    cache headroom), and the implicit default silently reserves lane
+    memory as if paging were off."""
+
+    id = "llm-decode-no-kv-budget"
+    severity = Severity.ERROR
+
+    def check(self, ctx: LintContext):
+        from ..filters.base import parse_custom_properties
+        for filt in ctx.of_kind("tensor_filter"):
+            opts = parse_custom_properties(str(filt.custom or ""))
+            paged = (opts.get("role") == "decode"
+                     or opts.get("paged", "").lower()
+                     in ("1", "true", "yes", "on"))
+            if not paged or "pool_blocks" in opts:
+                continue
+            # a decode-role serve replica makes the omission fatal in
+            # practice (every stream of the fleet lands here); flag the
+            # filter either way
+            yield self.finding(
+                "paged llm decode without custom=pool_blocks:N — the "
+                "KV pool silently defaults to the contiguous worst "
+                "case (n_parallel x max_len tokens), so decode "
+                "occupancy is not actually token-budgeted; size the "
+                "pool explicitly", filt.name, "sink")
+
+
+class LlmPrefixCacheLossyLinkRule(Rule):
+    """fp16 KV handoff feeding a content-addressed prefix cache: the
+    chain digest says 'same tokens, same KV' but the shipped blocks
+    were rounded through float16 (bf16 KV loses mantissa width, the
+    f32 logits lose range), so cached blocks differ bitwise from what
+    a local prefill would compute — hits stop being exact."""
+
+    id = "llm-prefix-cache-lossy-link"
+    severity = Severity.WARNING
+
+    def check(self, ctx: LintContext):
+        from ..filters.base import parse_custom_properties
+        for filt in ctx.of_kind("tensor_filter"):
+            opts = parse_custom_properties(str(filt.custom or ""))
+            if opts.get("kv_precision") != "fp16":
+                continue
+            ships = "handoff" in opts or opts.get("role") in ("prefill",
+                                                              "decode")
+            caches = opts.get("prefix_cache", "true").lower() \
+                not in ("0", "false", "no")
+            if ships and caches:
+                yield self.finding(
+                    "kv_precision:fp16 on a prefix-caching llm link: "
+                    "shipped KV blocks are float16-rounded, so the "
+                    "content-addressed cache serves blocks that no "
+                    "longer match a local prefill bit-for-bit; use "
+                    "kv_precision:bf16 (byte-exact for bf16 KV) or "
+                    "disable prefix_cache on this replica",
+                    filt.name, "sink")
+
+
 ALL_RULES: List[Rule] = [
     DanglingPadRule(), CycleRule(), TeeNoQueueRule(), JitSignatureRule(),
     ShardingRule(), ServeMeshRule(), MeshColocationRule(),
@@ -876,6 +939,7 @@ ALL_RULES: List[Rule] = [
     SessionReplayBudgetRule(), SessionNoReconnectRule(),
     RouterNoReplicasRule(), RouterAffinitySessionlessRule(),
     AsyncWindowRule(), StatefulNoCheckpointRule(), TraceExportRule(),
+    LlmDecodeNoKvBudgetRule(), LlmPrefixCacheLossyLinkRule(),
 ]
 
 

@@ -35,3 +35,25 @@ def dump_buffers(bufs) -> List[Dict]:
 
 def load_buffers(dumps) -> List[Buffer]:
     return [load_buffer(d) for d in dumps]
+
+
+# -- content addressing ------------------------------------------------
+
+def token_sha(tokens) -> str:
+    """Canonical sha256 hex digest of a token sequence.
+
+    The ONE hashing convention shared by the LLM snapshot re-adoption
+    path (match a resent prompt to a recovered stream without holding
+    the full token array comparison) and the paged KV prefix cache's
+    block chain (filters/kvpool.py): int32 little-endian token ids,
+    hashed in order. Keeping it here means a snapshot written by one
+    replica always matches the digest a resurrected replica computes.
+    """
+    import hashlib
+
+    import numpy as np
+
+    arr = np.ascontiguousarray(np.asarray(tokens, dtype=np.int32).ravel())
+    if arr.dtype.byteorder == ">":  # big-endian host: normalize
+        arr = arr.astype("<i4")
+    return hashlib.sha256(arr.tobytes()).hexdigest()

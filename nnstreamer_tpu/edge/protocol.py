@@ -82,6 +82,10 @@ class MsgKind(enum.IntEnum):
     PONG = 18       # echo of the PING's timestamp
     DRAIN = 19      # graceful teardown: admission is closing; in-flight
                     # frames flush + settle before the peer goes away
+    KV_XFER = 20    # prefill -> decode replica: a stream's prompt KV
+                    # blocks + last logits (edge/kv.py; wire-v2
+                    # precision negotiated at CAPS like any tensor link)
+    KV_ACK = 21     # decode replica's admission receipt ({sid, adopted})
 
 
 def resolve_dtype(name: str) -> np.dtype:

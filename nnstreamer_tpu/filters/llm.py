@@ -14,13 +14,30 @@ n_parallel, chunk.
 ``n_parallel:M`` (M>1) turns on continuous-batching decode: up to M
 concurrent prompts share ONE decode dispatch per token step (the
 TPU-first answer to llamacpp's n_batch, tensor_filter_llamacpp.cc:267)
-— prompts are prefetched into per-slot cache lanes as slots free up, so
-decode dispatch count scales with max(stream depth), not
-streams x tokens.
+— prompts are prefetched into cache slots as they free up, so decode
+dispatch count scales with max(stream depth), not streams x tokens.
+
+Disaggregated serving options (see Documentation/llm.md):
+
+* ``paged:true`` — back the scheduler with a block-granular KV pool
+  (``block_size:N`` tokens/block, ``pool_blocks:N`` budget) instead of
+  per-slot contiguous lanes: admission is token-budgeted, and with
+  ``prefix_cache:true`` (default in paged mode) prompts whose
+  block-aligned prefix chain is warm skip that part of prefill
+  entirely. Emitted token streams are bit-identical to the contiguous
+  path (the tests/test_llm_disagg.py parity gate).
+* ``role:prefill|decode|both`` — phase split across replicas: a
+  prefill replica runs only the prompt pass and ships the KV prefix to
+  ``handoff:host:port`` over the negotiated KV_XFER link (edge/kv.py,
+  ``kv_precision:none|bf16|fp16``); a decode replica (implies paged)
+  listens on ``handoff_port:N`` (0 = ephemeral; see
+  ``filter.handoff_port``) and folds shipped streams into its
+  continuous-batching loop.
 """
 from __future__ import annotations
 
 import threading
+import time
 import urllib.parse
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -38,6 +55,273 @@ from .registry import register_alias, register_filter
 # default cannot derive from each prompt's bucket; longer prompts need an
 # explicit custom=max_len:N.
 DEFAULT_BATCH_MAX_LEN = 128
+
+_TRUE = ("1", "true", "yes", "on")
+
+
+def _ctx_of(ctx: Any):
+    """The TraceContext riding on a Buffer-shaped invoke ctx, if any
+    (plain correlation tokens — ints, strings — carry none)."""
+    try:
+        from ..obs import context as _obs_ctx
+        return _obs_ctx.ctx_of(ctx)
+    except Exception:  # noqa: BLE001 — tracing is best-effort by design
+        return None
+
+
+class _PoolFull(Exception):
+    """Paged admission backpressure: the KV pool cannot cover this
+    stream right now — the scheduler requeues and retries as running
+    streams release blocks."""
+
+
+class _ContigBackend:
+    """Per-slot contiguous cache lanes (decode_step_multi): every slot
+    reserves a worst-case [max_len] lane, so occupancy is
+    stream-counted. The pre-paging layout, kept as the parity oracle
+    and for small deployments where the lane waste is irrelevant."""
+
+    def __init__(self, filt: "LlmFilter", m: int, max_len: int):
+        import jax.numpy as jnp
+
+        self.f = filt
+        self.max_len = max_len
+        self.cache = filt._tfm.init_cache_multi(filt._cfg, batch=m,
+                                                max_len=max_len)
+        self.logits = jnp.zeros((m, filt._cfg.vocab), jnp.float32)
+
+    def admit(self, slot: int, prompt: np.ndarray, budget: int) -> None:
+        import jax.numpy as jnp
+
+        l1, c1 = self.f._prefill_prompt(prompt, self.max_len)
+        self.cache = self.f._insert(self.cache, c1,
+                                    jnp.asarray(slot, jnp.int32))
+        self.logits = self.logits.at[slot].set(l1[0])
+
+    def admit_handoff(self, slot, prompt, kv, budget) -> None:
+        raise ValueError("llm: the contiguous cache cannot adopt a KV "
+                         "handoff; decode replicas need custom=paged:true")
+
+    def step(self, tok, active_np) -> None:
+        import jax.numpy as jnp
+
+        self.logits, self.cache = self.f._decode_multi(
+            self.f._params, self.cache, tok, jnp.asarray(active_np))
+
+    def chunk(self, k: int, temperature: float, keys, active_np):
+        import jax.numpy as jnp
+
+        toks, self.logits, self.cache, keys = self.f._chunk_fn(
+            k, temperature)(self.f._params, self.cache, self.logits,
+                            keys, jnp.asarray(active_np))
+        return toks, keys
+
+    def free(self, slot: int) -> None:
+        pass
+
+
+class _PagedBackend:
+    """Block-pool cache (decode_step_paged): slots address KV through
+    per-stream block tables over a shared arena, so occupancy is
+    token-budgeted — admission asks for exactly
+    ceil(min(plen + budget, max_len) / block_size) blocks, a long
+    conversation no longer pins a worst-case lane, and block-aligned
+    prompt prefixes can be shared through the content-addressed cache
+    (filters/kvpool.py)."""
+
+    def __init__(self, filt: "LlmFilter", m: int, max_len: int):
+        import jax.numpy as jnp
+
+        self.f = filt
+        self.max_len = max_len
+        self.bs = filt._block_size
+        self.w = -(-max_len // self.bs)
+        self.mgr = filt._pool_mgr
+        self.pool = filt._tfm.init_kv_pool(filt._cfg, self.mgr.n_blocks,
+                                           self.bs)
+        self.table_np = np.zeros((m, self.w), np.int32)
+        self._table_dev = None
+        self.index = jnp.zeros((m,), jnp.int32)
+        self.logits = jnp.zeros((m, filt._cfg.vocab), jnp.float32)
+        self.blocks: List[List[int]] = [[] for _ in range(m)]
+
+    def _table(self):
+        import jax.numpy as jnp
+
+        if self._table_dev is None:
+            self._table_dev = jnp.asarray(self.table_np)
+        return self._table_dev
+
+    def _need(self, plen: int, budget: int) -> int:
+        span = max(plen, min(plen + int(budget), self.max_len))
+        return -(-span // self.bs)
+
+    def _insert_span(self, blocks: List[int], k_np, v_np,
+                     valid: int) -> None:
+        """Block-align (k_np, v_np) [L, n, H, Dh] (first ``valid`` rows
+        real) and write them into ``blocks``. Rows past ``valid`` are
+        zeros the decode loop overwrites before its validity mask can
+        reach them — the same padded-tail argument as prefill's."""
+        import jax.numpy as jnp
+
+        layers, _, heads, hd = k_np.shape
+        spanf = len(blocks) * self.bs
+        kb = np.zeros((layers, spanf, heads, hd), k_np.dtype)
+        vb = np.zeros((layers, spanf, heads, hd), v_np.dtype)
+        n = min(int(valid), spanf, k_np.shape[1])
+        kb[:, :n] = k_np[:, :n]
+        vb[:, :n] = v_np[:, :n]
+        sh = (layers, len(blocks), self.bs, heads, hd)
+        self.pool = self.f._pool_insert(
+            self.pool, jnp.asarray(kb.reshape(sh)),
+            jnp.asarray(vb.reshape(sh)),
+            jnp.asarray(np.asarray(blocks, np.int32)))
+
+    def _suffix_prefill(self, past_k, past_v, past_len: int,
+                        suffix: np.ndarray):
+        """One prefill-with-past dispatch over pow2-bucketed shapes
+        (O(log^2) compiled variants across all split points)."""
+        import jax.numpy as jnp
+
+        sb = 8
+        while sb < suffix.size:
+            sb *= 2
+        padded = np.zeros(sb, np.int32)
+        padded[:suffix.size] = suffix
+        return self.f._prefill_past(
+            self.f._params, past_k, past_v,
+            jnp.asarray(past_len, jnp.int32), jnp.asarray(padded[None]),
+            jnp.asarray(suffix.size, jnp.int32))
+
+    def admit(self, slot: int, prompt: np.ndarray, budget: int) -> None:
+        from .kvpool import chain_hashes
+
+        import jax.numpy as jnp
+
+        f = self.f
+        plen = int(prompt.size)
+        need = self._need(plen, budget)
+        hashes = chain_hashes(prompt, self.bs)     # full blocks only
+        # adoption never covers the whole prompt: at least one suffix
+        # token recomputes (logits must come from somewhere), and the
+        # first decode-written block stays stream-private, which is
+        # what makes shared blocks read-only by construction
+        cover_cap = (plen - 1) // self.bs
+        cov = self.mgr.lookup(hashes[:cover_cap]) if f._prefix_cache \
+            else []
+        fresh = self.mgr.alloc(need - len(cov))
+        if fresh is None:
+            if cov:
+                self.mgr.release(cov)
+            raise _PoolFull(f"need {need - len(cov)} blocks")
+        allb = list(cov) + list(fresh)
+        p0 = len(cov) * self.bs
+        if cov:
+            nbb = 1
+            while nbb < len(cov):
+                nbb *= 2
+            phys_pad = list(cov) + [cov[-1]] * (nbb - len(cov))
+            pk, pv = f._pool_gather(
+                self.pool, jnp.asarray(np.asarray(phys_pad, np.int32)))
+            l1, sk, sv = self._suffix_prefill(pk, pv, p0, prompt[p0:])
+            f.stats.add(prefill_dispatches=1, prefill_cached_tokens=p0,
+                        prefill_computed_tokens=plen - p0)
+            self._insert_span(fresh, np.asarray(sk), np.asarray(sv),
+                              plen - p0)
+        else:
+            l1, c1 = f._prefill_prompt(prompt, self.max_len)
+            self._insert_span(allb, np.asarray(c1["k"][:, 0]),
+                              np.asarray(c1["v"][:, 0]), plen)
+        if f._prefix_cache and hashes:
+            self.mgr.commit(hashes, allb[:len(hashes)])
+        self._seat(slot, allb, need, plen, l1)
+
+    def admit_handoff(self, slot: int, flat: np.ndarray, kv: Dict,
+                      budget: int) -> None:
+        """Fold a wire-shipped KV prefix (edge/kv.py handoff dict) into
+        the pool. ``flat`` may extend the shipped prompt with tokens a
+        pre-crash replica already emitted (snapshot re-adoption): that
+        suffix is regrown by one prefill-with-past over the shipped
+        prefix, so resurrection costs the suffix, not the prompt."""
+        import jax.numpy as jnp
+
+        f = self.f
+        plen = int(flat.size)
+        t_ship = int(np.asarray(kv["prompt"]).size)
+        k_np = np.asarray(kv["k"])
+        v_np = np.asarray(kv["v"])
+        if k_np.ndim != 4 or k_np.shape[1] < t_ship:
+            raise ValueError(f"llm: malformed KV handoff {k_np.shape}")
+        f.stats.add(kv_shipped_tokens=t_ship)
+        if plen > t_ship:
+            pb = 8
+            while pb < t_ship:
+                pb *= 2
+            layers, _, heads, hd = k_np.shape
+            pk = np.zeros((layers, pb, heads, hd), k_np.dtype)
+            pv = np.zeros((layers, pb, heads, hd), v_np.dtype)
+            pk[:, :t_ship] = k_np[:, :t_ship]
+            pv[:, :t_ship] = v_np[:, :t_ship]
+            l1, sk, sv = self._suffix_prefill(
+                jnp.asarray(pk), jnp.asarray(pv), t_ship, flat[t_ship:])
+            f.stats.add(prefill_dispatches=1,
+                        prefill_computed_tokens=plen - t_ship)
+            full_k = np.concatenate(
+                [k_np[:, :t_ship],
+                 np.asarray(sk)[:, :plen - t_ship].astype(k_np.dtype)],
+                axis=1)
+            full_v = np.concatenate(
+                [v_np[:, :t_ship],
+                 np.asarray(sv)[:, :plen - t_ship].astype(v_np.dtype)],
+                axis=1)
+        else:
+            import jax.numpy as _jnp
+            l1 = _jnp.asarray(np.asarray(kv["logits"],
+                                         np.float32).reshape(1, -1))
+            full_k, full_v = k_np, v_np
+        need = self._need(plen, budget)
+        fresh = self.mgr.alloc(need)
+        if fresh is None:
+            raise _PoolFull(f"need {need} blocks")
+        self._insert_span(fresh, full_k, full_v, plen)
+        if f._prefix_cache:
+            from .kvpool import chain_hashes
+            hashes = chain_hashes(np.asarray(kv["prompt"], np.int32),
+                                  self.bs)
+            usable = min(len(hashes), need)
+            if usable:
+                self.mgr.commit(hashes[:usable], fresh[:usable])
+        self._seat(slot, list(fresh), need, plen, l1)
+
+    def _seat(self, slot: int, allb: List[int], need: int, plen: int,
+              l1) -> None:
+        self.table_np[slot, :need] = allb
+        self.table_np[slot, need:] = 0
+        self._table_dev = None
+        self.index = self.index.at[slot].set(plen)
+        self.logits = self.logits.at[slot].set(l1[0])
+        self.blocks[slot] = allb
+
+    def step(self, tok, active_np) -> None:
+        import jax.numpy as jnp
+
+        self.logits, self.pool, self.index = self.f._decode_paged(
+            self.f._params, self.pool, self._table(), self.index, tok,
+            jnp.asarray(active_np))
+
+    def chunk(self, k: int, temperature: float, keys, active_np):
+        import jax.numpy as jnp
+
+        toks, self.logits, self.pool, self.index, keys = \
+            self.f._chunk_fn_paged(k, temperature)(
+                self.f._params, self.pool, self._table(), self.index,
+                self.logits, keys, jnp.asarray(active_np))
+        return toks, keys
+
+    def free(self, slot: int) -> None:
+        if self.blocks[slot]:
+            self.mgr.release(self.blocks[slot])
+            self.blocks[slot] = []
 
 
 @register_filter
@@ -62,6 +346,13 @@ class LlmFilter(FilterFramework):
         # at the next invoke_async (see snapshot_state/restore_state)
         self._streams: Optional[List[Optional[Dict[str, Any]]]] = None
         self._recovered: Optional[Dict[str, Any]] = None
+        # disaggregated serving (role prop / paged pool)
+        self._role = "both"
+        self._paged = False
+        self._backend = None
+        self._pool_mgr = None
+        self._kv_rx = None
+        self._kv_tx = None
 
     def open(self, props: FilterProperties) -> None:
         import jax
@@ -130,6 +421,43 @@ class LlmFilter(FilterFramework):
         self._chunk = max(1, int(self._opts.get("chunk", "1")))
         self._chunk_jits: Dict[tuple, Any] = {}
         self._sampling_cache = None  # re-parse on every open()
+        # -- disaggregated serving / paged pool --------------------------
+        self._role = self._opts.get("role", "both")
+        if self._role not in ("both", "prefill", "decode"):
+            raise ValueError(f"llm: unknown role {self._role!r}; "
+                             "expected prefill|decode|both")
+        self._paged = (self._opts.get("paged", "false").lower() in _TRUE
+                       or self._role == "decode")
+        self._prefix_cache = self._opts.get(
+            "prefix_cache", "true").lower() in _TRUE
+        self._kv_precision = self._opts.get("kv_precision", "none")
+        self._block_size = max(1, int(self._opts.get("block_size", "16")))
+        self._batch_max_len = int(self._opts.get(
+            "max_len", str(DEFAULT_BATCH_MAX_LEN)))
+        self._backend = None
+        self._pool_mgr = None
+        if self._paged:
+            if self._n_parallel < 2:
+                raise ValueError(
+                    "llm: paged/decode mode requires n_parallel>1 — the "
+                    "block pool backs the continuous-batching scheduler")
+            from .kvpool import KVBlockPool
+            w = -(-self._batch_max_len // self._block_size)
+            # default budget matches the contiguous layout's worst case,
+            # so paged-by-default admits at least what lanes would
+            n_blocks = int(self._opts.get("pool_blocks",
+                                          str(self._n_parallel * w)))
+            self._pool_mgr = KVBlockPool(n_blocks, self._block_size,
+                                         name="llm")
+            max_len = self._batch_max_len
+            self._decode_paged = jax.jit(
+                lambda p, pool, tbl, idx, t, a: tfm.decode_step_paged(
+                    p, pool, tbl, idx, t, a, cfg, max_len=max_len))
+            self._pool_insert = jax.jit(tfm.pool_insert)
+            self._pool_gather = jax.jit(tfm.pool_gather)
+            self._prefill_past = jax.jit(
+                lambda p, pk, pv, pl, toks, tl: tfm.prefill_with_past(
+                    p, pk, pv, pl, toks, cfg, true_len=tl))
         with self._cond:
             # prompts queued before a close() belong to the previous
             # session (and carry its ctx buffers) — never replay them
@@ -140,14 +468,32 @@ class LlmFilter(FilterFramework):
         # (shared across n_parallel streams). decode_steps counts the
         # ACTUAL weight-reading steps executed (a chunked dispatch runs
         # an adaptive k <= chunk of them) — the honest multiplier for
-        # decode bandwidth accounting.
+        # decode bandwidth accounting. The token-granular prefill
+        # counters split prompt work into locally computed vs
+        # prefix-cache-warm vs wire-shipped tokens: computed is the
+        # chip-time cost, the other two are the savings.
         self.stats = Counters(prefill_dispatches=0, decode_dispatches=0,
-                              decode_steps=0)
+                              decode_steps=0, prefill_computed_tokens=0,
+                              prefill_cached_tokens=0, kv_shipped_tokens=0,
+                              kv_handoffs_in=0, kv_handoffs_out=0,
+                              kv_handoff_errors=0)
+        if self._role == "decode" or "handoff_port" in self._opts:
+            from ..edge.kv import KvReceiver
+            self._kv_rx = KvReceiver(
+                "0.0.0.0", int(self._opts.get("handoff_port", "0")),
+                self._on_kv_handoff, precision=self._kv_precision,
+                name="llm-kv-rx", stats=self.stats).start()
 
     def close(self) -> None:
         self._stop.set()
         with self._cond:
             self._cond.notify_all()
+        if self._kv_rx is not None:
+            self._kv_rx.stop()
+            self._kv_rx = None
+        if self._kv_tx is not None:
+            self._kv_tx.close()
+            self._kv_tx = None
         for t in self._threads:
             t.join(timeout=5.0)
         self._threads.clear()
@@ -156,6 +502,12 @@ class LlmFilter(FilterFramework):
             self._sched = None
         self._params = None
         self._decode = None
+
+    @property
+    def handoff_port(self) -> Optional[int]:
+        """The bound KV_XFER port of a decode-role filter (resolves
+        handoff_port:0 to the ephemeral port the OS picked)."""
+        return self._kv_rx.bound_port if self._kv_rx is not None else None
 
     def get_model_info(self):
         # prompt length is per-buffer (dynamic): input derives from caps
@@ -192,7 +544,8 @@ class LlmFilter(FilterFramework):
         logits, cache = self._prefill(
             self._params, cache, jnp.asarray(padded[None, :]),
             jnp.asarray(prompt.size, jnp.int32))
-        self.stats.inc("prefill_dispatches")
+        self.stats.add(prefill_dispatches=1,
+                       prefill_computed_tokens=int(prompt.size))
         return logits, cache
 
     def _sampling(self):
@@ -223,6 +576,24 @@ class LlmFilter(FilterFramework):
             fn = jax.jit(lambda p, c, l, k, a: tfm.decode_chunk_multi(
                 p, c, l, k, a, cfg, steps=steps, temperature=temperature,
                 top_k=top_k, top_p=top_p))
+            self._chunk_jits[key] = fn
+        return fn
+
+    def _chunk_fn_paged(self, steps: int, temperature: float):
+        """Paged twin of _chunk_fn (decode_chunk_paged over the pool +
+        block tables), cached per (steps, sampling)."""
+        top_k, top_p = self._sampling()
+        key = ("paged", steps, float(temperature), top_k, top_p)
+        fn = self._chunk_jits.get(key)
+        if fn is None:
+            import jax
+            tfm, cfg = self._tfm, self._cfg
+            max_len = self._batch_max_len
+            fn = jax.jit(
+                lambda p, pool, tbl, idx, l, k, a: tfm.decode_chunk_paged(
+                    p, pool, tbl, idx, l, k, a, cfg, steps=steps,
+                    max_len=max_len, temperature=temperature,
+                    top_k=top_k, top_p=top_p))
             self._chunk_jits[key] = fn
         return fn
 
@@ -309,14 +680,24 @@ class LlmFilter(FilterFramework):
 
     def invoke_async(self, inputs: Sequence[Any], ctx: Any = None) -> None:
         """1-in/N-out: one output frame per generated token, each
-        dispatched with this invoke's ``ctx``."""
+        dispatched with this invoke's ``ctx``. A prefill-role filter
+        dispatches nothing: it ships the prompt's KV to its decode home
+        and the decode replica emits the tokens."""
         prompt = np.asarray(inputs[0])
+        if self._role == "prefill":
+            flat = prompt.reshape(-1).astype(np.int32)
+            self._check_prompt(flat, self._batch_max_len)
+            t = threading.Thread(target=self._prefill_and_ship,
+                                 args=(flat, ctx),
+                                 name="llm-prefill-ship", daemon=True)
+            self._threads.append(t)
+            t.start()
+            return
         if self._n_parallel > 1:
             # validate on the CALLER's thread so an oversized prompt is a
             # visible invoke error, not a silent scheduler drop
             flat = prompt.reshape(-1)
-            self._check_prompt(flat, int(self._opts.get(
-                "max_len", str(DEFAULT_BATCH_MAX_LEN))))
+            self._check_prompt(flat, self._batch_max_len)
             with self._cond:
                 rem = None
                 if self._recovered is not None:
@@ -326,15 +707,7 @@ class LlmFilter(FilterFramework):
                     # acked session pre-crash) join the prefill context
                     # and only the undelivered remainder is generated
                     rem, flat = self._adopt_recovered_locked(flat)
-                self._pending.append((flat, ctx, rem))
-                self._cond.notify_all()
-                # start-check under the lock: two racing invokes must not
-                # spawn two schedulers splitting one slot pool
-                if self._sched is None or not self._sched.is_alive():
-                    self._sched = threading.Thread(
-                        target=self._sched_loop, name="llm-sched",
-                        daemon=True)
-                    self._sched.start()
+                self._enqueue_stream_locked((flat, ctx, rem))
             return
 
         def run():
@@ -348,6 +721,92 @@ class LlmFilter(FilterFramework):
         self._threads.append(t)
         t.start()
 
+    def _enqueue_stream_locked(self, entry: tuple) -> None:
+        """Queue a stream for the scheduler (caller holds _cond).
+        Start-check under the lock: two racing submitters must not
+        spawn two schedulers splitting one slot pool."""
+        self._pending.append(entry)
+        self._cond.notify_all()
+        if self._sched is None or not self._sched.is_alive():
+            self._sched = threading.Thread(
+                target=self._sched_loop, name="llm-sched", daemon=True)
+            self._sched.start()
+
+    # -- prefill/decode split (role prop + KV handoff) ---------------------
+    def _handoff_sender(self):
+        with self._cond:
+            if self._kv_tx is None:
+                target = self._opts.get("handoff", "")
+                if not target:
+                    raise ValueError(
+                        "llm: role:prefill requires custom=handoff:host:port")
+                host, _, port = target.rpartition(":")
+                from ..edge.kv import KvSender
+                self._kv_tx = KvSender(host or "127.0.0.1", int(port),
+                                       precision=self._kv_precision,
+                                       stats=self.stats)
+            return self._kv_tx
+
+    def _prefill_and_ship(self, flat: np.ndarray, ctx: Any) -> None:
+        """Prefill-role path: ONE prompt pass, then ship the KV prefix
+        + last logits to the decode home over KV_XFER. The trace
+        context (minted here when the invoke carried none) rides the
+        wire, so prefill -> handoff -> decode renders as one tree."""
+        from ..checkpoint.state import token_sha
+        from ..obs import spans as _spans
+        try:
+            max_tokens = int(self._opts.get("max_tokens", "16"))
+            t0 = time.time_ns()
+            tctx = _ctx_of(ctx)
+            if tctx is None and _spans.ENABLED:
+                from ..obs import context as _obs_ctx
+                tctx = _obs_ctx.TraceContext(_obs_ctx.next_id(), 0, t0)
+            l1, c1 = self._prefill_prompt(flat, self._batch_max_len)
+            t = int(flat.size)
+            k_np = np.asarray(c1["k"][:, 0, :t])
+            v_np = np.asarray(c1["v"][:, 0, :t])
+            if tctx is not None:
+                _spans.record_span("llm-prefill", "llm", t0,
+                                   max(0, time.time_ns() - t0), tctx)
+            ack = self._handoff_sender().send(
+                token_sha(flat), flat, k_np, v_np,
+                np.asarray(l1[0], np.float32), remaining=max_tokens,
+                seed=int(self._opts.get("seed", "0")), ctx=tctx)
+            self.stats.inc("kv_handoffs_out")
+            if not ack.get("adopted"):
+                self.stats.inc("kv_handoff_errors")
+                logger.error("llm: decode replica refused stream %s",
+                             ack.get("sid"))
+        except Exception:  # noqa: BLE001 — ship failures must be visible, not fatal
+            self.stats.inc("kv_handoff_errors")
+            logger.exception("llm: kv handoff failed")
+
+    def _on_kv_handoff(self, d: Dict) -> bool:
+        """KvReceiver callback (per-connection listener thread): queue a
+        shipped stream for paged admission. The returned flag becomes
+        the KV_ACK ``adopted`` receipt — False tells the prefill side
+        to try another decode home."""
+        if self._stop.is_set() or self._params is None:
+            return False
+        flat = np.asarray(d["prompt"], np.int32).reshape(-1)
+        try:
+            self._check_prompt(flat, self._batch_max_len)
+        except ValueError:
+            logger.exception("llm: rejected KV handoff %s", d.get("sid"))
+            return False
+        with self._cond:
+            rem = int(d.get("remaining", 0)) or None
+            if self._recovered is not None:
+                # a re-shipped conversation adopts its snapshot: the
+                # pre-crash emitted tokens join the context and only
+                # the undelivered remainder is generated
+                rem2, flat = self._adopt_recovered_locked(flat)
+                if rem2 is not None:
+                    rem = rem2
+            self._enqueue_stream_locked((flat, d.get("sid"), rem, d))
+        self.stats.inc("kv_handoffs_in")
+        return True
+
     # -- checkpoint/restore (checkpoint/) ----------------------------------
     def snapshot_state(self, snap_dir) -> Optional[Dict[str, Any]]:
         """Continuous-batching state for a preemption snapshot: per
@@ -358,9 +817,9 @@ class LlmFilter(FilterFramework):
         device cache). Single-stream mode (n_parallel=1) keeps no
         scheduler state and snapshots nothing."""
         with self._cond:
-            pend = [{"prompt": np.asarray(p, np.int32).tolist(),
-                     "emitted": [], "remaining": rem}
-                    for (p, _ctx, rem) in self._pending]
+            pend = [{"prompt": np.asarray(e[0], np.int32).tolist(),
+                     "emitted": [], "remaining": e[2]}
+                    for e in self._pending]
             act = [{"prompt": s["prompt"].tolist(),
                     "emitted": list(s["emitted"]),
                     "remaining": int(s["remaining"])}
@@ -372,20 +831,32 @@ class LlmFilter(FilterFramework):
 
     def restore_state(self, state, snap_dir) -> None:
         """Stash recovered streams; they are adopted lazily when a
-        re-submitted prompt (the client's RESUME-driven resend) matches
-        one of them — see invoke_async."""
+        re-submitted prompt (the client's RESUME-driven resend, or a
+        re-shipped KV handoff) matches one of them — see invoke_async
+        and _on_kv_handoff."""
         with self._cond:
             self._recovered = state
 
     def _adopt_recovered_locked(self, flat: np.ndarray):
         """Match an incoming prompt against the recovered streams
-        (caller holds _cond). On a hit: continuation — the pre-crash
-        prompt + already-emitted tokens become the prefill context and
-        only the remaining budget is generated. Returns
-        (remaining_override, prompt_to_queue)."""
+        (caller holds _cond). Matching is by content digest
+        (checkpoint.state.token_sha — the same digest that names wire
+        handoffs), computed once per entry and once for the incoming
+        prompt, instead of a full array comparison per entry. On a
+        hit: continuation — the pre-crash prompt + already-emitted
+        tokens become the prefill context and only the remaining
+        budget is generated. Returns (remaining_override,
+        prompt_to_queue)."""
+        from ..checkpoint.state import token_sha
+
         entries = self._recovered.get("streams") or []
+        sha = token_sha(flat)
         for i, ent in enumerate(entries):
-            if np.array_equal(np.asarray(ent["prompt"], np.int32), flat):
+            esha = ent.get("_sha")
+            if esha is None:
+                esha = ent["_sha"] = token_sha(
+                    np.asarray(ent.get("prompt") or [], np.int32))
+            if esha == sha:
                 entries.pop(i)
                 if not entries:
                     self._recovered = None
@@ -409,24 +880,40 @@ class LlmFilter(FilterFramework):
         except Exception:  # noqa: BLE001 — daemon thread: log, don't die silent
             logger.exception("llm scheduler failed; in-flight streams lost")
 
+    def _finish_span(self, s: Dict[str, Any]) -> None:
+        """A stream just finished: close its llm-decode span so the
+        conversation's trace tree has a terminal node on this replica."""
+        tctx = s.get("tctx")
+        if tctx is None:
+            return
+        from ..obs import spans as _spans
+        t0 = s.get("t0") or time.time_ns()
+        _spans.record_span("llm-decode", "llm", t0,
+                           max(0, time.time_ns() - t0), tctx)
+
     def _sched_body(self) -> None:
         import jax
         import jax.numpy as jnp
 
-        tfm, cfg = self._tfm, self._cfg
         m = self._n_parallel
         max_tokens = int(self._opts.get("max_tokens", "16"))
-        max_len = int(self._opts.get("max_len", str(DEFAULT_BATCH_MAX_LEN)))
+        max_len = self._batch_max_len
         temperature = float(self._opts.get("temperature", "0"))
         seed = int(self._opts.get("seed", "0"))
-        cache = tfm.init_cache_multi(cfg, batch=m, max_len=max_len)
-        logits = jnp.zeros((m, cfg.vocab), jnp.float32)
+        # the cache layout is a pluggable backend: contiguous per-slot
+        # lanes (stream-counted) or the paged block pool
+        # (token-budgeted). Admission, sampling, dispatch bookkeeping
+        # and snapshots are THIS one loop either way — the parity gate
+        # only has to reason about the cache math, not two schedulers.
+        backend = (_PagedBackend(self, m, max_len) if self._paged
+                   else _ContigBackend(self, m, max_len))
+        self._backend = backend
         tok = jnp.zeros((m,), jnp.int32)
         streams: List[Optional[Dict[str, Any]]] = [None] * m
         with self._cond:
             self._streams = streams  # published for snapshot_state
         while not self._stop.is_set():
-            # -- admit pending prompts into free slots
+            # -- admit pending streams into free slots
             with self._cond:
                 while all(s is None for s in streams) and not self._pending \
                         and not self._stop.is_set():
@@ -436,35 +923,71 @@ class LlmFilter(FilterFramework):
                 admit = []
                 for slot in range(m):
                     if streams[slot] is None and self._pending:
-                        admit.append((slot, *self._pending.pop(0)))
-            for slot, prompt, ctx, rem in admit:
+                        admit.append((slot, self._pending.pop(0)))
+            requeue = []
+            for slot, entry in admit:
+                prompt, ctx, rem = entry[0], entry[1], entry[2]
+                kv = entry[3] if len(entry) > 3 else None
+                budget = max_tokens if rem is None else int(rem)
+                t_admit = time.time_ns()
                 try:
-                    self._check_prompt(prompt, max_len)
-                    l1, c1 = self._prefill_prompt(prompt, max_len)
+                    if kv is not None:
+                        backend.admit_handoff(slot, prompt, kv, budget)
+                    else:
+                        self._check_prompt(prompt, max_len)
+                        backend.admit(slot, prompt, budget)
+                except _PoolFull:
+                    # token-budgeted admission: not enough KV blocks
+                    # right now — requeue; running streams release
+                    # blocks as they finish
+                    requeue.append(entry)
+                    continue
                 except Exception:  # noqa: BLE001 — drop THIS prompt only
                     logger.exception("llm: prompt rejected at admission")
                     continue
-                cache = self._insert(cache, c1, jnp.asarray(slot, jnp.int32))
-                logits = logits.at[slot].set(l1[0])
+                tctx = kv.get("ctx") if kv is not None else _ctx_of(ctx)
+                if tctx is not None and kv is None:
+                    from ..obs import spans as _spans
+                    _spans.record_span("llm-prefill", "llm", t_admit,
+                                       max(0, time.time_ns() - t_admit),
+                                       tctx)
                 # per-stream PRNG key: the sample sequence matches the
                 # n_parallel=1 path for the same seed, independent of
                 # which other prompts happen to be in flight. rem
                 # overrides the budget for a stream adopted from a
-                # preemption snapshot (the rest was emitted pre-crash).
+                # preemption snapshot (the rest was emitted pre-crash);
+                # handoff streams sample with the seed the prefill
+                # replica shipped, so the split emits the monolithic
+                # token stream.
                 streams[slot] = {"ctx": ctx,
-                                 "remaining": (max_tokens if rem is None
-                                               else int(rem)),
+                                 "remaining": budget,
                                  "pos": int(prompt.size),
                                  "prompt": np.asarray(prompt,
                                                       np.int32).copy(),
                                  "emitted": [],
-                                 "key": jax.random.PRNGKey(seed)}
+                                 "key": jax.random.PRNGKey(
+                                     int(kv["seed"]) if kv is not None
+                                     else seed),
+                                 "tctx": tctx, "t0": t_admit}
+            if requeue:
+                with self._cond:
+                    if all(s is None for s in streams):
+                        # nothing is running, so nothing will ever free
+                        # blocks: the head request exceeds the whole
+                        # pool — drop it loudly instead of deadlocking
+                        head = requeue.pop(0)
+                        logger.error(
+                            "llm: stream of %d tokens needs more KV "
+                            "blocks than pool_blocks=%d holds; dropped",
+                            int(np.asarray(head[0]).size),
+                            self._pool_mgr.n_blocks)
+                    self._pending[:0] = requeue
             active_np = np.array([s is not None for s in streams])
             if not active_np.any():
                 continue
             if self._chunk > 1:
-                logits, cache = self._sched_chunk(
-                    streams, active_np, logits, cache, max_len, temperature)
+                self._sched_chunk(streams, active_np, backend, max_len,
+                                  temperature)
                 continue
             # -- sample on device, D2H just the M token ids
             if temperature > 0:
@@ -476,9 +999,10 @@ class LlmFilter(FilterFramework):
                     s["key"], sub = jax.random.split(s["key"])
                     subs.append(sub)
                 tok = self._tfm.sample_logits(
-                    jnp.stack(subs), logits, temperature, *self._sampling())
+                    jnp.stack(subs), backend.logits, temperature,
+                    *self._sampling())
             else:
-                tok = jnp.argmax(logits, -1)
+                tok = jnp.argmax(backend.logits, -1)
             tok = tok.astype(jnp.int32)
             tok_host = np.asarray(tok)
             for slot, s in enumerate(streams):
@@ -499,16 +1023,17 @@ class LlmFilter(FilterFramework):
                     streams[slot] = None
                     # keep the mask current: a lane that just finished
                     # must not keep writing/advancing its cache in the
-                    # trailing decode (decode_step_multi also position-
+                    # trailing decode (the decode step also position-
                     # guards at max_len)
                     active_np[slot] = False
+                    backend.free(slot)
+                    self._finish_span(s)
             if active_np.any():
-                logits, cache = self._decode_multi(
-                    self._params, cache, tok, jnp.asarray(active_np))
+                backend.step(tok, active_np)
                 self.stats.add(decode_dispatches=1, decode_steps=1)
 
-    def _sched_chunk(self, streams, active_np, logits, cache, max_len,
-                     temperature):
+    def _sched_chunk(self, streams, active_np, backend, max_len,
+                     temperature) -> None:
         """One chunked round of the continuous-batching loop: K
         sample+decode steps in ONE dispatch, K tokens per stream per
         host fetch. K adapts to the deepest stream still running, so a
@@ -523,7 +1048,7 @@ class LlmFilter(FilterFramework):
         # The +1 is the capacity tail: the final token a lane emits at
         # pos == max_len is sampled in-scan from the last legal decode's
         # logits — the decode that FOLLOWS that sample is position-
-        # guarded inside decode_step_multi (pos < max_len), so it cannot
+        # guarded inside the decode step (pos < max_len), so it cannot
         # clamp a write onto row max_len-1 (the single-stream invariant
         # of _generate_chunked, enforced in-graph here).
         emits_left = [min(s["remaining"], max_len - s["pos"] + 1)
@@ -539,8 +1064,7 @@ class LlmFilter(FilterFramework):
                               for s in streams])
         else:
             keys = jnp.zeros((len(streams), 2), jnp.uint32)
-        toks, logits, cache, keys = self._chunk_fn(k, temperature)(
-            self._params, cache, logits, keys, jnp.asarray(active_np))
+        toks, keys = backend.chunk(k, temperature, keys, active_np)
         self.stats.add(decode_dispatches=1, decode_steps=k)
         toks_host = np.asarray(toks)  # [k, M]: ONE fetch for the chunk
         for slot, s in enumerate(streams):
@@ -556,7 +1080,8 @@ class LlmFilter(FilterFramework):
                 s["key"] = keys[slot]
             if s["remaining"] <= 0 or s["pos"] > max_len:
                 streams[slot] = None
-        return logits, cache
+                backend.free(slot)
+                self._finish_span(s)
 
 
 register_alias("llamacpp", "llm")

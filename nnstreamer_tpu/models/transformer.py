@@ -425,6 +425,225 @@ def decode_chunk_multi(params, cache, logits, keys, active, cfg: GPTConfig,
     return toks, logits, cache, keys
 
 
+# -- paged KV pool (block-granular cache, vLLM-style) ---------------------
+#
+# The contiguous multi-stream cache above reserves a worst-case
+# [max_len] lane per slot, so decode occupancy is stream-counted. The
+# pool below is the token-budgeted alternative: a shared arena of
+# fixed-size blocks ([L, NB, bs, H, Dh]) addressed through per-stream
+# block tables, with allocation/refcounts/prefix-sharing managed
+# host-side (filters/kvpool.py). decode_step_paged gathers a stream's
+# blocks into the SAME [B, max_len] layout decode_step_multi attends
+# over and runs the identical op sequence on it, so the paged path is
+# bit-exact against the contiguous path on CPU — the parity gate
+# tests/test_llm_disagg.py enforces.
+
+def init_kv_pool(cfg: GPTConfig, n_blocks: int, block_size: int) -> Dict[str, Any]:
+    """Block arena: {"k","v"} [L, NB, bs, H, Dh]. Block 0 is an
+    ordinary block; the host allocator decides which phys ids are live.
+    Index NB (one past the end) is the discard target for guarded
+    scatter writes (mode="drop")."""
+    shape = (cfg.n_layers, n_blocks, block_size, cfg.n_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def pool_insert(pool, kb, vb, phys):
+    """Write whole blocks: kb/vb [L, nb, bs, H, Dh] into phys [nb].
+    Entire blocks are replaced, so a reused block cannot leak its
+    previous occupant's rows into the freshly inserted span."""
+    return {"k": pool["k"].at[:, phys].set(kb.astype(pool["k"].dtype),
+                                           mode="drop"),
+            "v": pool["v"].at[:, phys].set(vb.astype(pool["v"].dtype),
+                                           mode="drop")}
+
+
+def pool_copy_block(pool, src, dst):
+    """Copy-on-write helper: duplicate block ``src`` into ``dst`` so a
+    writer can diverge from a shared prefix block without touching the
+    readers' copy."""
+    return {"k": pool["k"].at[:, dst].set(pool["k"][:, src]),
+            "v": pool["v"].at[:, dst].set(pool["v"][:, src])}
+
+
+def pool_gather(pool, phys):
+    """Gather blocks phys [nb] -> contiguous (k, v) [L, nb*bs, H, Dh]
+    (the shipped-KV / prefill-with-past layout)."""
+    k = pool["k"][:, phys]
+    v = pool["v"][:, phys]
+    flat = (k.shape[0], k.shape[1] * k.shape[2], k.shape[3], k.shape[4])
+    return k.reshape(flat), v.reshape(flat)
+
+
+def decode_step_paged(params, pool, table, index, token, active,
+                      cfg: GPTConfig, *, max_len: int):
+    """One decode step for B streams whose KV lives in pool blocks.
+
+    table [B, W] int32 maps each stream's block index to a phys block;
+    index [B] is the per-stream position. Each layer gathers the
+    stream's blocks into a contiguous [B, max_len] view and then runs
+    decode_step_multi's exact op sequence on it (same one-row masked
+    update, same einsums, same [B, max_len] mask shape), so logits are
+    bit-identical to the contiguous path — gathered bytes equal lane
+    bytes, and the trailing W*bs - max_len garbage columns are sliced
+    off before the softmax ever sees them. The new row is persisted
+    into the pool by a separate guarded scatter: inactive / at-capacity
+    lanes aim at phys id NB (one past the arena) and mode="drop"
+    discards the write, the scatter-shaped form of decode_step_multi's
+    "guarded lanes rewrite their old row" trick.
+
+    Returns (logits [B,V], pool', index'). Shared prefix blocks are
+    never written: the host allocator caps prefix adoption below the
+    first decode-written block, so every scatter target is
+    stream-private by construction."""
+    b = token.shape[0]
+    nb, bs_blk = pool["k"].shape[1], pool["k"].shape[2]
+    hd, nh = cfg.head_dim, cfg.n_heads
+    pos = index                                # [B]
+    positions = pos[:, None]
+    h = jnp.take(params["embed"], token[:, None], axis=0)
+    valid = jnp.arange(max_len)[None, :] <= pos[:, None]
+    ok = active & (pos < max_len)
+    lane = ok[:, None, None, None]
+    upd = jax.vmap(
+        lambda c, x, p: jax.lax.dynamic_update_slice(c, x, (p, 0, 0)))
+    row = jax.vmap(
+        lambda c, p: jax.lax.dynamic_slice(
+            c, (p, 0, 0), (1, c.shape[1], c.shape[2])))
+    blk = jnp.clip(pos // bs_blk, 0, table.shape[1] - 1)
+    phys = jnp.take_along_axis(table, blk[:, None], axis=1)[:, 0]
+    tgt = jnp.where(ok, phys, nb)              # nb = discard target
+    off = pos % bs_blk
+    k_rows, v_rows = [], []
+    for i, layer in enumerate(params["layers"]):
+        kc = pool["k"][i][table].reshape(b, -1, nh, hd)[:, :max_len]
+        vc = pool["v"][i][table].reshape(b, -1, nh, hd)[:, :max_len]
+        x = rmsnorm(h, layer["ln1"])
+        q = rope((x @ layer["wq"]).reshape(b, 1, nh, hd), positions,
+                 cfg.rope_theta)
+        k1 = rope((x @ layer["wk"]).reshape(b, 1, nh, hd), positions,
+                  cfg.rope_theta)
+        v1 = (x @ layer["wv"]).reshape(b, 1, nh, hd)
+        kd = jnp.where(lane, k1.astype(kc.dtype), row(kc, pos))
+        vd = jnp.where(lane, v1.astype(vc.dtype), row(vc, pos))
+        k = upd(kc, kd, pos)
+        v = upd(vc, vd, pos)
+        k_rows.append(kd[:, 0])
+        v_rows.append(vd[:, 0])
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+        scores = scores * (hd ** -0.5)
+        scores = jnp.where(valid[:, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        h = h + attn.reshape(b, 1, -1) @ layer["wo"]
+        x = rmsnorm(h, layer["ln2"])
+        ff = jax.nn.silu(x @ layer["w1"]) * (x @ layer["w3"])
+        h = h + ff @ layer["w2"]
+    h = rmsnorm(h, params["ln_f"])
+    logits = (h[:, 0] @ params["head"]).astype(jnp.float32)
+    pool = {"k": pool["k"].at[:, tgt, off].set(jnp.stack(k_rows),
+                                               mode="drop"),
+            "v": pool["v"].at[:, tgt, off].set(jnp.stack(v_rows),
+                                               mode="drop")}
+    return logits, pool, pos + ok.astype(jnp.int32)
+
+
+def decode_chunk_paged(params, pool, table, index, logits, keys, active,
+                       cfg: GPTConfig, *, steps: int, max_len: int,
+                       temperature: float = 0.0, top_k: int = 0,
+                       top_p: float = 1.0):
+    """``steps`` sample+decode rounds over the paged cache in ONE
+    dispatch — decode_chunk_multi's scan body with decode_step_paged
+    substituted. The block table is a scan constant: the scheduler
+    admits new streams only between chunks, and each stream's blocks
+    are preallocated through its emit budget, so no table edit can be
+    needed mid-chunk. The per-stream key-split order matches
+    decode_chunk_multi exactly, so paged chunked generation emits the
+    same tokens as every other path for the same seed.
+
+    Returns (tokens [steps, B] int32, logits, pool, index, keys)."""
+    def body(carry, _):
+        lg, pl, idx, ks = carry
+        if temperature > 0:
+            pair = jax.vmap(jax.random.split)(ks)
+            ks2, subs = pair[:, 0], pair[:, 1]
+            tok = sample_logits(subs, lg, temperature, top_k, top_p)
+        else:
+            ks2 = ks
+            tok = sample_logits(ks, lg, 0.0)
+        lg2, pl2, idx2 = decode_step_paged(
+            params, pl, table, idx, tok, active, cfg, max_len=max_len)
+        return (lg2, pl2, idx2, ks2), tok
+
+    (logits, pool, index, keys), toks = jax.lax.scan(
+        body, (logits, pool, index, keys), None, length=steps)
+    return toks, logits, pool, index, keys
+
+
+def prefill_with_past(params, past_k, past_v, past_len, tokens,
+                      cfg: GPTConfig, true_len=None):
+    """Suffix prefill over an existing KV prefix: run the prompt TAIL
+    (tokens [1, S], ``true_len`` real) with attention over
+    concat(past, suffix), where past_k/past_v [L, P, H, Dh] hold
+    ``past_len`` valid rows (the rest padded garbage, column-masked).
+
+    This is the other half of the prefix cache and of the wire KV
+    handoff: a prompt whose first ``past_len`` tokens hit warm blocks
+    (or arrived from a prefill replica) only pays compute for the
+    suffix. RoPE positions are offset by ``past_len`` (traced, so one
+    compiled variant serves every split point of a (P, S) bucket pair)
+    and causality is by absolute position, exactly as in block().
+
+    Returns (logits [1, V] at suffix position true_len-1,
+    suffix K [L, S, H, Dh], suffix V) — the caller block-aligns and
+    inserts the suffix KV into the pool."""
+    b, s = tokens.shape
+    p = past_k.shape[1]
+    hd, nh = cfg.head_dim, cfg.n_heads
+    p0 = jnp.asarray(past_len, jnp.int32)
+    pos_q = p0 + jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    past_cols = jnp.arange(p, dtype=jnp.int32)
+    # padded past rows sit at absolute positions < pos_q, so the causal
+    # mask alone would admit them — the column-validity mask is load-bearing
+    col_ok = jnp.concatenate([past_cols < p0, jnp.ones((s,), bool)])
+    h = jnp.take(params["embed"], tokens, axis=0)
+    new_k, new_v = [], []
+    for i, layer in enumerate(params["layers"]):
+        x = rmsnorm(h, layer["ln1"])
+        q = rope((x @ layer["wq"]).reshape(b, s, nh, hd), pos_q,
+                 cfg.rope_theta)
+        k = rope((x @ layer["wk"]).reshape(b, s, nh, hd), pos_q,
+                 cfg.rope_theta)
+        v = (x @ layer["wv"]).reshape(b, s, nh, hd)
+        fk = jnp.concatenate(
+            [jnp.broadcast_to(past_k[i][None].astype(k.dtype),
+                              (b, p, nh, hd)), k], axis=1)
+        fv = jnp.concatenate(
+            [jnp.broadcast_to(past_v[i][None].astype(v.dtype),
+                              (b, p, nh, hd)), v], axis=1)
+        pos_k = jnp.concatenate(
+            [jnp.broadcast_to(past_cols, (b, p)), pos_q], axis=1)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, fk).astype(jnp.float32)
+        scores = scores * (hd ** -0.5)
+        mask = (pos_q[:, None, :, None] >= pos_k[:, None, None, :]) \
+            & col_ok[None, None, None, :]
+        scores = jnp.where(mask, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, fv)
+        h = h + attn.reshape(b, s, -1) @ layer["wo"]
+        x = rmsnorm(h, layer["ln2"])
+        ff = jax.nn.silu(x @ layer["w1"]) * (x @ layer["w3"])
+        h = h + ff @ layer["w2"]
+        new_k.append(k)
+        new_v.append(v)
+    h = rmsnorm(h, params["ln_f"])
+    t_eff = jnp.asarray(s if true_len is None else true_len, jnp.int32)
+    h_last = jax.lax.dynamic_slice_in_dim(h, t_eff - 1, 1, axis=1)[:, 0]
+    logits = (h_last @ params["head"]).astype(jnp.float32)
+    # single-stream path (b == 1): drop the batch dim so the suffix KV
+    # has the same [L, S, H, Dh] layout as shipped / gathered KV
+    return logits, jnp.stack(new_k)[:, 0], jnp.stack(new_v)[:, 0]
+
+
 @register_model("gpt")
 def _build_gpt(vocab: str = "32000", d_model: str = "512", n_heads: str = "8",
                n_layers: str = "6", seq: str = "128", seed: str = "0"):

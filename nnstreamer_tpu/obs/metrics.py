@@ -245,6 +245,32 @@ def render() -> str:
                 f"nns_serve_batch_latency_us"
                 f"{_labels(serve=sid, name=sched.name, quantile=q)} {v}")
 
+    # 3b) KV block pools (paged LLM serving): occupancy is the
+    # admission budget, the hit ratio is the prefix cache earning (or
+    # not earning) its blocks
+    from ..filters.kvpool import POOL_TABLE, _POOL_LOCK
+    with _POOL_LOCK:
+        pools = dict(POOL_TABLE)
+    if pools:
+        lines.append("# TYPE nns_kv_blocks_free gauge")
+        lines.append("# TYPE nns_kv_blocks_used gauge")
+        lines.append("# TYPE nns_kv_blocks_cached gauge")
+        lines.append("# TYPE nns_kv_prefix_hit_ratio gauge")
+        lines.append("# TYPE nns_kv_prefix_evictions_total counter")
+    for pname, pool in sorted(pools.items()):
+        try:
+            d = pool.stats_dict()
+        except Exception:  # noqa: BLE001 — a scrape never takes the runtime down
+            continue
+        lab = _labels(pool=pname)
+        lines.append(f"nns_kv_blocks_free{lab} {d['blocks_free']}")
+        lines.append(f"nns_kv_blocks_used{lab} {d['blocks_used']}")
+        lines.append(f"nns_kv_blocks_cached{lab} {d['blocks_cached']}")
+        lines.append(
+            f"nns_kv_prefix_hit_ratio{lab} {d['prefix_hit_ratio']:.6f}")
+        lines.append(
+            f"nns_kv_prefix_evictions_total{lab} {d['prefix_evictions']}")
+
     # 4) attached tracers: the full report, flattened — every
     # Counters/Reservoir trace.py aggregates becomes a series
     emitted_trace_type = False

@@ -78,7 +78,12 @@ class TensorServeSrc(SrcElement):
              # multiples of the data-parallel degree and each stacked
              # batch is device_put batch-major across the mesh before
              # dispatch — one sharded invoke per batch. "" = per-chip.
-             "mesh": ""}
+             "mesh": "",
+             # disaggregated LLM serving: advertise this replica's phase
+             # ("prefill" | "decode" | "both"; "" = not an LLM replica)
+             # so the fleet router can steer prompt frames to prefill
+             # capacity and pin each stream's decode home
+             "llm-role": ""}
 
     # the scheduler records queue_wait + batch spans on the request ctx
     SPAN_POINTS = ("queue-wait", "batch", "chain")
@@ -147,6 +152,8 @@ class TensorServeSrc(SrcElement):
                     (self.dest_host or "localhost", int(self.dest_port)),
                     timeout=self.timeout)
                 reg_meta = dict(self.scheduler.occupancy(), role="serve")
+                if str(self.llm_role):
+                    reg_meta["llm_role"] = str(self.llm_role)
                 if self._restored is not None:
                     # resurrection announcement: the router counts these
                     # and knows the replica carries restored session ids
@@ -250,10 +257,12 @@ class TensorServeSrc(SrcElement):
                     # occupancy snapshot it carries (uses the per-conn
                     # send lock — a PONG must not interleave with a
                     # RESULT the sink thread is writing)
+                    load = (self.scheduler.occupancy()
+                            if self.scheduler is not None else {})
+                    if str(self.llm_role):
+                        load = dict(load, llm_role=str(self.llm_role))
                     self._send(cid, MsgKind.PONG,
-                               {"t": meta.get("t"),
-                                "load": self.scheduler.occupancy()
-                                if self.scheduler is not None else {}})
+                               {"t": meta.get("t"), "load": load})
                 elif kind == MsgKind.EOS:
                     break
         except (ConnectionError, OSError, ValueError) as exc:

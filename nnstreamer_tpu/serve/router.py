@@ -126,6 +126,13 @@ class _Replica:
         self.draining = False
         self.load: Dict = {}
 
+    @property
+    def llm_role(self) -> str:
+        """The replica's advertised LLM phase ("prefill" | "decode" |
+        "both"; "" = not an LLM replica), carried by REGISTER metadata
+        and refreshed by every PONG load report."""
+        return str((self.load or {}).get("llm_role") or "")
+
     def state(self) -> str:
         if self.draining:
             return DRAINING
@@ -196,9 +203,15 @@ class FleetRouter:
         # currently eligible for NEW dispatches (live, not draining)
         self._replicas: Dict[str, _Replica] = {}
         self._ring = HashRing()
+        # decode-home ring for disaggregated LLM fleets: consistent
+        # hashing over the DECODE-capable replicas only, so a stream's
+        # decode home survives prefill membership churn (and vice
+        # versa). Mirrors _ring while no replica advertises an llm_role.
+        self._dring = HashRing()
         self._rlock = threading.Lock()
-        # rseq -> [cid, client seq, buffer, replica key, attempts]: every
-        # dispatched-but-unsettled request; the failover unit
+        # rseq -> [cid, client seq, buffer, replica key, attempts,
+        # llm phase]: every dispatched-but-unsettled request; the
+        # failover unit
         self._pending: Dict[int, list] = {}
         # rseqs retired by _drop_client (their client died first): a late
         # replica answer for one is an orphan answer, not a failover
@@ -296,7 +309,8 @@ class FleetRouter:
                         continue  # no handshake, no route
                     buf = wire.unpack_buffer(meta, payloads,
                                              stats=self.stats)
-                    self._dispatch(cid, buf, meta.get("seq"), skey)
+                    self._dispatch(cid, buf, meta.get("seq"), skey,
+                                   phase=meta.get("llm_phase"))
                 elif kind == MsgKind.DATA_BATCH:
                     if cid is None:
                         continue
@@ -361,7 +375,7 @@ class FleetRouter:
 
     # -- dispatch ----------------------------------------------------------
     def _dispatch(self, cid: int, buf: Buffer, cseq, skey: Optional[str],
-                  attempts: int = 0) -> None:
+                  attempts: int = 0, phase: Optional[str] = None) -> None:
         if attempts == 0:
             self.stats.inc("router_requests")
         if self._draining:
@@ -369,7 +383,10 @@ class FleetRouter:
             return
         tried: set = set()
         while True:
-            snap = self._pick(skey, tried)
+            # phase is only forwarded when present: _pick's 2-arg form
+            # stays a stable seam (tests stub it for race injection)
+            snap = (self._pick(skey, tried, phase) if phase
+                    else self._pick(skey, tried))
             if snap is None or attempts > self.max_redispatch:
                 # no dispatchable replica (or the request already
                 # ping-ponged through max_redispatch deaths): settle it
@@ -381,9 +398,19 @@ class FleetRouter:
             with self._plock:
                 self._rseq += 1
                 rseq = self._rseq
-                self._pending[rseq] = [cid, cseq, buf, key, attempts]
+                self._pending[rseq] = [cid, cseq, buf, key, attempts,
+                                       phase]
             meta, payloads = wire.pack_buffer(buf, cfg, stats=self.stats)
             meta["seq"] = rseq
+            if phase:
+                meta["llm_phase"] = phase
+                if phase == "prompt" and skey is not None:
+                    # pin the stream's decode home so the prefill
+                    # replica ships its KV where every later frame of
+                    # this session will also land
+                    home = self.decode_home(skey)
+                    if home is not None:
+                        meta["decode_home"] = home
             try:
                 with slock:
                     send_msg(sock, MsgKind.DATA, meta, payloads,
@@ -405,19 +432,40 @@ class FleetRouter:
                 tried.add(key)
                 attempts += 1
 
-    def _pick(self, skey: Optional[str], exclude: set
+    def _pick(self, skey: Optional[str], exclude: set,
+              phase: Optional[str] = None
               ) -> Optional[Tuple[str, socket.socket, threading.Lock,
                                   Optional[wire.WireConfig]]]:
         """Choose a replica: ring affinity first, least-loaded among the
         live ones otherwise. Returns a snapshot (key, sock, send lock,
-        wire cfg) taken under the replica lock; None = nobody can serve."""
+        wire cfg) taken under the replica lock; None = nobody can serve.
+
+        Disaggregated LLM fleets add a phase filter: ``phase="prompt"``
+        frames go to prefill capacity (dedicated ``prefill`` replicas
+        first, ``both`` as spillover) and skip the affinity ring —
+        prompts are stateless, least-loaded wins; ``phase="decode"``
+        frames pin to the stream's decode home on the decode ring. A
+        fleet where nobody advertises a role ignores the phase."""
         with self._rlock:
             live = [r for r in self._replicas.values()
                     if r.sock is not None and not r.draining
                     and r.key not in exclude]
+            if phase and any(r.llm_role for r in live):
+                if phase == "prompt":
+                    pref = [r for r in live if r.llm_role == "prefill"]
+                    live = pref or [r for r in live
+                                    if r.llm_role in ("prefill", "both")]
+                elif phase == "decode":
+                    live = [r for r in live
+                            if r.llm_role in ("decode", "both")]
+                    want = (self._dring.lookup(skey)
+                            if skey is not None else None)
+                    for r in live:
+                        if r.key == want:
+                            return (r.key, r.sock, r.slock, r.cfg)
             if not live:
                 return None
-            if self.affinity and skey is not None:
+            if self.affinity and skey is not None and phase != "prompt":
                 want = self._ring.lookup(skey)
                 for r in live:
                     if r.key == want:
@@ -518,9 +566,16 @@ class FleetRouter:
         return True
 
     def _rebuild_ring_locked(self) -> None:
-        self._ring.rebuild(sorted(
-            r.key for r in self._replicas.values()
-            if r.sock is not None and not r.draining))
+        live = [r for r in self._replicas.values()
+                if r.sock is not None and not r.draining]
+        self._ring.rebuild(sorted(r.key for r in live))
+        # the decode ring only narrows once someone actually advertises
+        # a phase; a role-free fleet keeps decode_home == assignment
+        roled = [r for r in live if r.llm_role]
+        decode = [r.key for r in roled
+                  if r.llm_role in ("decode", "both")]
+        self._dring.rebuild(sorted(decode) if roled
+                            else sorted(r.key for r in live))
 
     def _replica_loop(self, rep: _Replica, sock: socket.socket) -> None:
         try:
@@ -558,7 +613,13 @@ class FleetRouter:
                     load = meta.get("load")
                     if isinstance(load, dict):
                         with self._rlock:
+                            rechain = (str(load.get("llm_role") or "")
+                                       != rep.llm_role)
                             rep.load = load
+                            if rechain:
+                                # a phase (dis)appeared: the decode-home
+                                # ring membership just changed
+                                self._rebuild_ring_locked()
                 elif kind == MsgKind.DRAIN:
                     # the replica's pipeline is draining: it will settle
                     # what it admitted and shed the rest — steer new
@@ -629,7 +690,8 @@ class FleetRouter:
         for _, ent in victims:
             self.stats.inc("router_redispatched")
             self._dispatch(ent[0], ent[2], ent[1], self._skey_of(ent[0]),
-                           attempts=ent[4] + 1)
+                           attempts=ent[4] + 1,
+                           phase=ent[5] if len(ent) > 5 else None)
 
     # -- maintenance: heartbeats, re-dials, membership ---------------------
     def _maintain(self) -> None:
@@ -747,6 +809,13 @@ class FleetRouter:
         with self._rlock:
             return self._ring.lookup(skey)
 
+    def decode_home(self, skey: str) -> Optional[str]:
+        """The decode-capable replica this session is pinned to
+        (consistent hash over the decode ring) — where prompt-phase
+        dispatches tell the prefill replica to ship its KV."""
+        with self._rlock:
+            return self._dring.lookup(skey)
+
     def replica_keys(self) -> List[str]:
         with self._rlock:
             return sorted(self._replicas)
@@ -764,6 +833,7 @@ class FleetRouter:
             out[r.key] = {
                 "state": r.state(),
                 "origin": r.origin,
+                "llm_role": r.llm_role,
                 "in_flight": inflight.get(r.key, 0),
                 "load": dict(r.load or {}),
                 "breaker": r.breaker.state,

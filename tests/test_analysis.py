@@ -603,3 +603,61 @@ class TestTraceExportRule:
             "tensor_transform mode=typecast option=float32 ! fakesink",
             "trace-export-stripped")
         assert got == []
+
+
+class TestLlmDisaggRules:
+    def test_decode_without_pool_budget_is_error(self):
+        bad = (  # pipelint: skip — decode replica with an implicit pool
+            "tensor_serve_src name=s llm-role=decode ! "
+            "tensor_filter name=f framework=llm model=zoo://gpt "
+            'custom="role:decode,n_parallel:4" ! tensor_serve_sink')
+        got = findings_for(bad, "llm-decode-no-kv-budget")
+        assert [(f.element, f.pad) for f in got] == [("f", "sink")]
+        assert got[0].severity is Severity.ERROR
+        assert "pool_blocks" in got[0].message
+
+    def test_paged_without_budget_also_flagged(self):
+        bad = (  # pipelint: skip — paged filter, no pool budget
+            "tensor_serve_src name=s ! "
+            "tensor_filter name=f framework=llm model=zoo://gpt "
+            'custom="paged:true,n_parallel:2" ! tensor_serve_sink')
+        got = findings_for(bad, "llm-decode-no-kv-budget")
+        assert [f.element for f in got] == ["f"]
+
+    def test_budgeted_decode_is_clean(self):
+        ok = ("tensor_serve_src name=s llm-role=decode ! "
+              "tensor_filter name=f framework=llm model=zoo://gpt "
+              'custom="role:decode,n_parallel:4,pool_blocks:64" ! '
+              "tensor_serve_sink")
+        assert findings_for(ok, "llm-decode-no-kv-budget") == []
+
+    def test_contiguous_llm_not_flagged(self):
+        ok = ("tensor_serve_src name=s ! "
+              "tensor_filter name=f framework=llm model=zoo://gpt "
+              'custom="n_parallel:4" ! tensor_serve_sink')
+        assert findings_for(ok, "llm-decode-no-kv-budget") == []
+
+    def test_fp16_handoff_into_prefix_cache_warns(self):
+        bad = (  # pipelint: skip — fp16 KV feeding the prefix cache
+            "tensor_serve_src name=s llm-role=prefill ! "
+            "tensor_filter name=f framework=llm model=zoo://gpt "
+            'custom="role:prefill,handoff:127.0.0.1:6000,'
+            'kv_precision:fp16" ! tensor_serve_sink')
+        got = findings_for(bad, "llm-prefix-cache-lossy-link")
+        assert [(f.element, f.severity) for f in got] == \
+            [("f", Severity.WARNING)]
+        assert "fp16" in got[0].message and "bf16" in got[0].message
+
+    def test_bf16_handoff_is_clean(self):
+        ok = ("tensor_serve_src name=s llm-role=prefill ! "
+              "tensor_filter name=f framework=llm model=zoo://gpt "
+              'custom="role:prefill,handoff:127.0.0.1:6000,'
+              'kv_precision:bf16" ! tensor_serve_sink')
+        assert findings_for(ok, "llm-prefix-cache-lossy-link") == []
+
+    def test_fp16_without_cache_is_clean(self):
+        ok = ("tensor_serve_src name=s llm-role=decode ! "
+              "tensor_filter name=f framework=llm model=zoo://gpt "
+              'custom="role:decode,pool_blocks:64,kv_precision:fp16,'
+              'prefix_cache:false" ! tensor_serve_sink')
+        assert findings_for(ok, "llm-prefix-cache-lossy-link") == []
