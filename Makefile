@@ -11,7 +11,7 @@ EXAMPLES := $(BUILD)/custom_passthrough.so $(BUILD)/custom_scaler.so
 
 .PHONY: native clean test check tier1 lint racecheck chaos chaos-zeroloss \
 	chaos-fleet chaos-preempt chaos-llm fuse-parity async-parity \
-	shard-parity obs-overhead package
+	shard-parity delta-parity obs-overhead package
 
 native: $(LIB) $(EXAMPLES)
 
@@ -25,6 +25,7 @@ check: native lint racecheck
 	$(MAKE) fuse-parity
 	$(MAKE) async-parity
 	$(MAKE) shard-parity
+	$(MAKE) delta-parity
 	$(MAKE) chaos
 	$(MAKE) chaos-fleet
 	$(MAKE) chaos-preempt
@@ -52,6 +53,16 @@ async-parity:
 # nonzero on any divergence, and on vacuous coverage).
 shard-parity:
 	env JAX_PLATFORMS=cpu python tools/shard_parity.py
+
+# `make delta-parity` = the temporal-delta transport's byte-parity
+# oracle: a built-in stream suite (motion, static, promotion, layout
+# change, bitwise NaN payloads, bf16 composition, live socket) run over
+# a negotiated wire-codec=delta link vs a raw control link — decoded
+# bytes must be identical, and the suite must actually ship sparse
+# diffs (tools/delta_parity.py exits nonzero on divergence and on
+# vacuous coverage).
+delta-parity:
+	env JAX_PLATFORMS=cpu python tools/delta_parity.py
 
 # `make chaos` = the full fault-injection harness: the slow seeded
 # serve-pipeline schedules (excluded from tier-1 by the slow marker)

@@ -716,6 +716,154 @@ def bench_wire_row() -> dict:
     return out
 
 
+# -- delta transport row: temporal keyframe+diff codec vs wire v2 zlib -------
+
+DELTA_ROW_FRAMES = 120
+
+
+def _delta_motion_frames(n: int = DELTA_ROW_FRAMES,
+                         side: int = 224, patch: int = 50):
+    """Synthetic ~5%-motion camera stream: a fixed sensor-noise frame
+    (the codec-hostile case — zlib finds nothing) with one random
+    ``patch x patch`` region redrawn per frame (2500/50176 ≈ 5% of the
+    pixels). This is exactly the traffic the delta codec exists for:
+    per-frame zlib can't compress it, per-frame diffing almost all of
+    it away can."""
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    cur = rng.integers(0, 255, (side, side, 3), np.uint8, endpoint=True)
+    frames = [cur.copy()]
+    for _ in range(n - 1):
+        cur = cur.copy()
+        y = int(rng.integers(0, side - patch))
+        x = int(rng.integers(0, side - patch))
+        cur[y:y + patch, x:x + patch] = rng.integers(
+            0, 255, (patch, patch, 3), np.uint8, endpoint=True)
+        frames.append(cur.copy())
+    return frames
+
+
+def _delta_stream(cfg, frames_list):
+    """Stream ``frames_list`` (distinct frames — temporal codecs need
+    real motion, not copies) through a real localhost TCP connection;
+    the receiver fully decodes under its own accepted config. Returns
+    (bytes_on_wire_per_frame, sender fps, decoded arrays in order)."""
+    import socket as _socket
+
+    from nnstreamer_tpu import Buffer
+    from nnstreamer_tpu.edge import wire
+    from nnstreamer_tpu.edge.protocol import MsgKind, recv_msg, send_msg
+    from nnstreamer_tpu.utils.atomic import Counters
+
+    lst = _socket.socket()
+    lst.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+    lst.bind(("localhost", 0))
+    lst.listen(1)
+    done = threading.Event()
+    got: list = []
+    # the receiving end of the link mints its config from the sender's
+    # negotiated meta, exactly like edgesrc at CAPS_ACK
+    rx_cfg = wire.accept(cfg.to_meta()) if cfg is not None else None
+
+    def serve():
+        conn, _ = lst.accept()
+        try:
+            while len(got) < len(frames_list):
+                kind, meta, payloads = recv_msg(conn)
+                if kind != MsgKind.DATA:
+                    break
+                buf = wire.unpack_buffer(meta, payloads, cfg=rx_cfg)
+                got.append(buf.chunks[0].host().copy())
+        finally:
+            done.set()
+            conn.close()
+
+    threading.Thread(target=serve, daemon=True).start()
+    out = _socket.create_connection(("localhost", lst.getsockname()[1]))
+    wire.tune_socket(out)
+    stats = Counters()
+    t0 = time.perf_counter()
+    for f in frames_list:
+        meta, payloads = wire.pack_buffer(Buffer.from_arrays([f]), cfg,
+                                          stats=stats)
+        send_msg(out, MsgKind.DATA, meta, payloads, stats=stats)
+    done.wait(timeout=120)
+    wall = time.perf_counter() - t0
+    out.close()
+    lst.close()
+    snap = stats.snapshot()
+    return (snap.get("wire_bytes_out", 0) / len(frames_list),
+            len(frames_list) / wall, got)
+
+
+def bench_delta_transport_row() -> dict:
+    """Delta transport row (ISSUE 15 acceptance): the synthetic
+    5%-motion 224x224x3 stream over a real socket, three arms — v1 raw
+    control, wire v2 zlib, and the temporal delta codec. The verdict is
+    "delta" only when (1) delta sheds >80% of the bytes the zlib arm
+    pays, (2) the EFFECTIVE per-stream fps — sender throughput capped
+    by what the ~5-10 MB/s link budget (ROADMAP item 5) permits at
+    each arm's bytes/frame — rises over zlib's, (3) every decoded
+    frame is byte-identical to the delta-disabled control arm, and
+    (4) negotiation falls back cleanly in both directions against a
+    peer that doesn't know the codec. Localhost hides the link, so the
+    byte cap is applied analytically at the budget midpoint; the raw
+    sender fps of every arm stays in the row for the codec-cost read."""
+    import numpy as np
+
+    from nnstreamer_tpu.edge import wire
+
+    frames = _delta_motion_frames()
+    raw_b, raw_fps, raw_out = _delta_stream(None, frames)
+    zlib_cfg = wire.negotiate(wire.advertise(), codec="zlib")
+    zlib_b, zlib_fps, zlib_out = _delta_stream(zlib_cfg, frames)
+    delta_cfg = wire.negotiate(wire.advertise(), codec="delta")
+    delta_b, delta_fps, delta_out = _delta_stream(delta_cfg, frames)
+
+    reduction = 100.0 * (1.0 - delta_b / zlib_b) if zlib_b else 0.0
+    parity = (len(delta_out) == len(frames)
+              and all(np.array_equal(g, f)
+                      for g, f in zip(delta_out, frames))
+              and len(raw_out) == len(frames)
+              and all(np.array_equal(g, f)
+                      for g, f in zip(raw_out, frames)))
+    budget_bytes_s = 7.5e6  # midpoint of the ~5-10 MB/s link budget
+    eff_zlib = min(zlib_fps, budget_bytes_s / zlib_b) if zlib_b else 0.0
+    eff_delta = min(delta_fps, budget_bytes_s / delta_b) if delta_b else 0.0
+    fps_rises = eff_delta > eff_zlib
+
+    # negotiation fallback, both directions: an old peer advertises no
+    # "delta" in its codec list; a delta-requesting accepter must clamp
+    # to a codec both sides speak, and a delta wish from the peer must
+    # never be adopted without a local request
+    old_peer = dict(wire.advertise())
+    old_peer["codecs"] = ["raw", "zlib", "shuffle-zlib"]
+    away = wire.negotiate(old_peer, codec="delta")
+    toward = wire.negotiate(wire.advertise(codec="delta"))
+    fallback_ok = (away is not None and away.codec != wire.CODEC_DELTA
+                   and toward is not None
+                   and toward.codec != wire.CODEC_DELTA)
+
+    verdict_ok = reduction > 80.0 and parity and fps_rises and fallback_ok
+    return {"delta_transport": {
+        "frames": len(frames),
+        "raw_bytes_per_frame": round(raw_b),
+        "zlib_bytes_per_frame": round(zlib_b),
+        "delta_bytes_per_frame": round(delta_b),
+        "bytes_reduction_vs_zlib_pct": round(reduction, 1),
+        "sender_fps": {"raw": round(raw_fps), "zlib": round(zlib_fps),
+                       "delta": round(delta_fps)},
+        "effective_fps_at_link_budget": {"zlib": round(eff_zlib, 1),
+                                         "delta": round(eff_delta, 1)},
+        "effective_fps_gain": (round(eff_delta / eff_zlib, 2)
+                               if eff_zlib else None),
+        "parity_with_delta_disabled": parity,
+        "fallback_clean_both_directions": fallback_ok,
+        "verdict": "delta" if verdict_ok else "NO-SAVINGS",
+    }}
+
+
 def bench_chaos_zeroloss_row(n_frames: int = 60, every: int = 10) -> dict:
     """Chaos row (ISSUE 7 acceptance): a session edge link with seeded
     kill-link faults injected mid-stream — while the publisher coalesces
@@ -1494,7 +1642,7 @@ def _compact_summary(result: dict) -> str:
         if k in top1:
             cex[k] = top1[k]
     for k in ("chaos_zeroloss", "fleet_failover", "async_overlap",
-              "sharded_serve", "llm_disagg"):
+              "sharded_serve", "llm_disagg", "delta_transport"):
         if isinstance(ex.get(k), dict):
             cex[f"{k}_verdict"] = ex[k].get("verdict")
     if isinstance(ex.get("llm_disagg"), dict):
@@ -1727,6 +1875,15 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001
         print(f"# wire row failed: {e}", file=sys.stderr)
         extras["wire_bytes_reduction_pct"] = None
+
+    # delta transport row: temporal keyframe+diff codec vs wire v2 zlib
+    # on the 5%-motion stream (ISSUE 15). Comparative A/B on a real
+    # local socket with an analytic link-budget cap; self-adjudicating.
+    try:
+        extras.update(bench_delta_transport_row())
+    except Exception as e:  # noqa: BLE001
+        print(f"# delta transport row failed: {e}", file=sys.stderr)
+        extras["delta_transport"] = None
 
     # chaos row: a session edge link under seeded mid-stream link kills
     # must deliver every frame exactly once (ISSUE 7). Host-side only,

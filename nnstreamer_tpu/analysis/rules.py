@@ -930,6 +930,64 @@ class LlmPrefixCacheLossyLinkRule(Rule):
                     filt.name, "sink")
 
 
+class DeltaNoKeyframeIntervalRule(Rule):
+    """Delta wire codec with no finite keyframe interval: the link's
+    only scheduled resynchronization points are gone. A subscriber that
+    joins late, or whose reference drifts for any unforeseen reason,
+    then has no bounded-time path back to a self-contained frame — the
+    stream degrades into diffs against state only the sender has."""
+
+    id = "delta-no-keyframe-interval"
+    severity = Severity.ERROR
+
+    def check(self, ctx: LintContext):
+        from ..edge.wire import CODEC_DELTA
+        for e in ctx.of_kind("edgesink"):
+            if str(getattr(e, "wire_codec", "raw")) != CODEC_DELTA:
+                continue
+            k = int(getattr(e, "wire_delta_k", 0))
+            if k <= 0:
+                yield self.finding(
+                    f"wire-codec=delta with wire-delta-k={k}: no finite "
+                    "keyframe interval — only connect/layout-change/"
+                    "promotion keyframes remain, so a reference that "
+                    "drifts has no bounded-time resync; set "
+                    "wire-delta-k to a positive frame count", e.name)
+
+
+class DeltaLossyGateFeedsTrainerRule(Rule):
+    """tensor_delta's gate/roi modes drop unchanged frames and tiles —
+    exactly right for inference, silently wrong for training: the
+    dropped samples are the (heavily static) majority class, so a
+    trainer downstream learns from a motion-biased subsample without
+    anyone opting in."""
+
+    id = "delta-lossy-gate-feeds-trainer"
+    severity = Severity.WARNING
+
+    def check(self, ctx: LintContext):
+        for e in ctx.of_kind("tensor_delta"):
+            mode = str(getattr(e, "mode", "gate"))
+            if mode not in ("gate", "roi"):
+                continue  # mask mode annotates only; nothing is dropped
+            seen: Set[str] = set()
+            stack = list(ctx.downstream(e))
+            while stack:
+                d = stack.pop()
+                if d.name in seen:
+                    continue
+                seen.add(d.name)
+                if kind_of(d) == "tensor_trainer":
+                    yield self.finding(
+                        f"tensor_delta mode={mode} drops unchanged "
+                        f"frames/tiles and the survivors feed trainer "
+                        f"'{d.name}': the training distribution is "
+                        "motion-biased; train from a mask-mode tap or "
+                        "the ungated stream", e.name)
+                    break
+                stack.extend(ctx.downstream(d))
+
+
 ALL_RULES: List[Rule] = [
     DanglingPadRule(), CycleRule(), TeeNoQueueRule(), JitSignatureRule(),
     ShardingRule(), ServeMeshRule(), MeshColocationRule(),
@@ -940,6 +998,7 @@ ALL_RULES: List[Rule] = [
     RouterNoReplicasRule(), RouterAffinitySessionlessRule(),
     AsyncWindowRule(), StatefulNoCheckpointRule(), TraceExportRule(),
     LlmDecodeNoKvBudgetRule(), LlmPrefixCacheLossyLinkRule(),
+    DeltaNoKeyframeIntervalRule(), DeltaLossyGateFeedsTrainerRule(),
 ]
 
 

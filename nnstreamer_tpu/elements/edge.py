@@ -63,8 +63,12 @@ class EdgeSink(SinkElement):
              "connect-type": "TCP",
              # wire v2 link request, applied per subscriber that
              # advertises support (v1 subscribers keep plain framing):
-             # lossless payload codec + opt-in lossy fp32 downcast
+             # lossless payload codec + opt-in lossy fp32 downcast.
+             # wire-codec=delta ships keyframes every wire-delta-k
+             # frames and sparse diffs between them (per-link reference
+             # state; v1/v2-old subscribers fall back to raw)
              "wire-codec": "raw", "wire-precision": "none",
+             "wire-delta-k": wire.DELTA_KEYFRAME_INTERVAL,
              # frame coalescing: broadcast up to N frames per message
              # (DATA_BATCH, v2 subscribers only), flushing a partial
              # batch once its oldest frame has waited coalesce-ms
@@ -186,7 +190,8 @@ class EdgeSink(SinkElement):
                 # block) gets plain framing and never sees DATA_BATCH
                 cfg = wire.negotiate(meta.get("wire"),
                                      codec=str(self.wire_codec),
-                                     precision=str(self.wire_precision))
+                                     precision=str(self.wire_precision),
+                                     delta_k=int(self.wire_delta_k))
                 # session fold, same shape: no "session" block in the
                 # SUBSCRIBE = no session = strict v1 on this link
                 scfg = None
@@ -377,9 +382,15 @@ class EdgeSink(SinkElement):
         for sub in subs:
             cfg = sub.cfg
             with_seq = sub.sid is not None and seqs is not None
-            key = (None if cfg is None
-                   else (cfg.codec, cfg.precision, len(frames) > 1),
-                   with_seq)
+            if cfg is not None and cfg.codec == wire.CODEC_DELTA:
+                # delta frames are encoded against this link's own
+                # reference state — never share packed bytes across
+                # subscribers (id(cfg) is unique per connection)
+                key = (id(cfg), with_seq)
+            else:
+                key = (None if cfg is None
+                       else (cfg.codec, cfg.precision, len(frames) > 1),
+                       with_seq)
             msgs = packed.get(key)
             if msgs is None:
                 if cfg is not None and len(frames) > 1:
@@ -492,6 +503,11 @@ class EdgeSrc(SrcElement):
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self._sock: Optional[socket.socket] = None
+        # the wire config adopted from the publisher's CAPS_ACK echo —
+        # minted fresh at every (re)subscribe, which is what resets the
+        # delta receiver reference state in lockstep with the
+        # publisher's fresh per-connection sender state
+        self._wire_cfg: Optional[wire.WireConfig] = None
         # frames from an unpacked DATA_BATCH beyond the first, drained
         # before the next recv (only the source loop touches this)
         self._rxq: "collections.deque" = collections.deque()
@@ -569,6 +585,9 @@ class EdgeSrc(SrcElement):
         kind, meta, _ = recv_msg(sock)
         if kind != MsgKind.CAPS_ACK:
             raise ConnectionError(f"{self.name}: subscribe rejected ({kind})")
+        # adopt the publisher's choice; a fresh WireConfig per
+        # (re)connect means fresh delta reference state on both ends
+        self._wire_cfg = wire.accept(meta.get("wire"))
         scfg = sess_mod.accept(meta.get("session")) if self.session else None
         if scfg is not None:
             self._resume(sock, scfg)
@@ -750,7 +769,14 @@ class EdgeSrc(SrcElement):
             if self._hb is not None:
                 self._hb.heard()
             if kind == MsgKind.DATA:
-                buf = wire.unpack_buffer(meta, payloads, stats=self.stats)
+                try:
+                    buf = wire.unpack_buffer(meta, payloads,
+                                             stats=self.stats,
+                                             cfg=self._wire_cfg)
+                except ValueError as exc:
+                    if self._decode_failed(exc):
+                        continue
+                    return None
                 if self._sess is not None:
                     if not self._sess.admit(meta.get("seq")):
                         # a replayed frame we already delivered before
@@ -762,7 +788,14 @@ class EdgeSrc(SrcElement):
                     self._maybe_ack()
                 return buf
             if kind == MsgKind.DATA_BATCH:
-                frames = wire.unpack_batch(meta, payloads, stats=self.stats)
+                try:
+                    frames = wire.unpack_batch(meta, payloads,
+                                               stats=self.stats,
+                                               cfg=self._wire_cfg)
+                except ValueError as exc:
+                    if self._decode_failed(exc):
+                        continue
+                    return None
                 if self._sess is not None:
                     kept = []
                     for f in frames:
@@ -793,6 +826,20 @@ class EdgeSrc(SrcElement):
                 self._final_ack()
                 return None
         return None
+
+    def _decode_failed(self, exc: ValueError) -> bool:
+        """An undecodable frame (e.g. a delta diff against a reference
+        this side does not hold — never silently patch the wrong
+        baseline) is a link fault: tear the connection down and
+        re-handshake. The fresh link restarts from a keyframe and a
+        session resume replays the gap. True = reconnected."""
+        if self._stop_evt.is_set() or self._drain_evt.is_set():
+            return False
+        self.stats.inc("link_errors")
+        self._breaker.record_failure()
+        logger.warning("%s: undecodable frame (%s); re-subscribing",
+                       self.name, exc)
+        return bool(self.reconnect and self._reconnect())
 
     def drain_flushed(self) -> bool:
         return not self._rxq

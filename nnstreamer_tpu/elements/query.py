@@ -30,6 +30,31 @@ from ..tensors.caps import Caps
 from ..utils.log import logger
 
 
+def _roi_meta(buf: Buffer) -> Optional[dict]:
+    """The tensor_delta ROI side-band (which crops these are, cut from
+    what) as a wire-meta block: buffer extras don't cross the link, so
+    the client stamps this next to ``seq`` on DATA and the server
+    echoes it on RESULT for the downstream tensor_delta_stitch."""
+    rois = buf.extras.get("delta_rois")
+    if rois is None:
+        return None
+    return {"rois": [list(r) for r in rois],
+            "grid": list(buf.extras.get("delta_grid", ())),
+            "tile": int(buf.extras.get("delta_tile", 0)),
+            "shape": list(buf.extras.get("delta_shape", ()))}
+
+
+def _roi_adopt(buf: Buffer, roi: Optional[dict]) -> Buffer:
+    """Inverse of :func:`_roi_meta`: rebuild the stitch extras on a
+    RESULT buffer from the echoed block."""
+    if roi and roi.get("rois"):
+        buf.extras["delta_rois"] = [tuple(r) for r in roi["rois"]]
+        buf.extras["delta_grid"] = tuple(roi.get("grid", ()))
+        buf.extras["delta_tile"] = int(roi.get("tile", 0))
+        buf.extras["delta_shape"] = tuple(roi.get("shape", ()))
+    return buf
+
+
 class _ServerTable:
     """Pairs serversrc/serversink by id and routes client connections
     (≙ GstTensorQueryServerInfo table, tensor_query_server.c)."""
@@ -582,6 +607,9 @@ class TensorQueryClient(Element):
                     meta, payloads = wire.pack_buffer(entry[0], cfg,
                                                       stats=self.stats)
                     meta["seq"] = entry[1]
+                    roi = _roi_meta(entry[0])
+                    if roi is not None:
+                        meta["delta_roi"] = roi
                     send_msg(sock, MsgKind.DATA, meta, payloads,
                              stats=self.stats)
                     entry[2] = gen
@@ -652,6 +680,9 @@ class TensorQueryClient(Element):
                         meta, payloads = wire.pack_buffer(buf, cfg,
                                                           stats=self.stats)
                         meta["seq"] = seq
+                        roi = _roi_meta(buf)
+                        if roi is not None:
+                            meta["delta_roi"] = roi
                         send_msg(sock, MsgKind.DATA, meta, payloads,
                                  stats=self.stats)
                         entry[2] = gen
@@ -764,8 +795,9 @@ class TensorQueryClient(Element):
                     # push before releasing: on_eos drains by acquiring all
                     # permits, so releasing first would let EOS overtake
                     # (and drop) this final result downstream
-                    self.srcpad.push(wire.unpack_buffer(meta, payloads,
-                                                        stats=self.stats))
+                    self.srcpad.push(_roi_adopt(
+                        wire.unpack_buffer(meta, payloads, stats=self.stats),
+                        meta.get("delta_roi")))
                     self.stats.inc("session_delivered")
                     inflight.release()
                 elif kind == MsgKind.EOS:

@@ -23,9 +23,30 @@ from ..tensors.meta import HEADER_SIZE, TensorMetaInfo
 from ..tensors.types import TensorFormat, TensorType
 
 
-def sparse_encode(arr: np.ndarray) -> bytes:
-    flat = arr.reshape(-1)
-    idx = np.flatnonzero(flat).astype(np.uint32)
+def sparse_encode(arr: np.ndarray, ref: Optional[np.ndarray] = None) -> bytes:
+    """Dense -> sparse wire bytes. Absolute mode (``ref=None``) encodes
+    the non-zero elements; diff mode encodes the elements that differ
+    from ``ref`` — compared bitwise, so NaN payloads and -0.0/+0.0 flips
+    survive the round trip exactly. Decode diff-mode bytes with the same
+    ``ref`` (the wire layout is identical; whose baseline the indices
+    patch is the caller's contract — the delta wire codec keys it to the
+    link's reference epoch)."""
+    flat = np.ascontiguousarray(arr).reshape(-1)
+    if ref is None:
+        idx = np.flatnonzero(flat).astype(np.uint32)
+    else:
+        rflat = np.ascontiguousarray(ref).reshape(-1)
+        if rflat.shape != flat.shape or rflat.dtype != flat.dtype:
+            raise ValueError(
+                f"sparse diff reference mismatch: {flat.dtype}{flat.shape} "
+                f"vs {rflat.dtype}{rflat.shape}")
+        itemsize = flat.dtype.itemsize
+        if itemsize == 1:
+            changed = flat.view(np.uint8) != rflat.view(np.uint8)
+        else:
+            changed = (flat.view(np.uint8).reshape(-1, itemsize) !=
+                       rflat.view(np.uint8).reshape(-1, itemsize)).any(axis=1)
+        idx = np.flatnonzero(changed).astype(np.uint32)
     vals = flat[idx]
     meta = TensorMetaInfo(
         type=TensorType.from_dtype(arr.dtype), format=TensorFormat.SPARSE,
@@ -48,9 +69,21 @@ def _parse_sparse(data: bytes):
     return meta, idx, vals
 
 
-def sparse_decode(data: bytes) -> np.ndarray:
+def sparse_decode(data: bytes, ref: Optional[np.ndarray] = None) -> np.ndarray:
+    """Inverse of :func:`sparse_encode`. With ``ref`` the output starts
+    from a copy of the reference (diff mode) instead of zeros; the
+    returned array never aliases ``ref``."""
     meta, idx, vals = _parse_sparse(data)
-    out = np.zeros(math.prod(meta.shape), vals.dtype)
+    size = math.prod(meta.shape)
+    if ref is None:
+        out = np.zeros(size, vals.dtype)
+    else:
+        rflat = np.ascontiguousarray(ref).reshape(-1)
+        if rflat.size != size or rflat.dtype != vals.dtype:
+            raise ValueError(
+                f"sparse diff reference mismatch: {vals.dtype}[{size}] "
+                f"vs {rflat.dtype}[{rflat.size}]")
+        out = rflat.copy()
     out[idx] = vals
     return out.reshape(meta.shape)
 

@@ -271,6 +271,29 @@ def render() -> str:
         lines.append(
             f"nns_kv_prefix_evictions_total{lab} {d['prefix_evictions']}")
 
+    # 3c) delta transport: the wire codec's keyframe/diff economics plus
+    # the compute-skip gate, aggregated across every registered pipeline
+    # — the fleet-level "bytes and invokes we did not pay for" series
+    delta = {"keyframes": 0, "diffs": 0, "promotions": 0, "bytes_saved": 0,
+             "frames_skipped": 0, "tiles_skipped": 0, "tiles_total": 0}
+    for p in pipelines:
+        for e in getattr(p, "elements", {}).values():
+            try:
+                snap = e.stats.snapshot()
+            except Exception:  # noqa: BLE001 — a scrape never takes the runtime down
+                continue
+            delta["keyframes"] += snap.get("wire_delta_keyframes", 0)
+            delta["diffs"] += snap.get("wire_delta_diffs", 0)
+            delta["promotions"] += snap.get("wire_delta_promotions", 0)
+            delta["bytes_saved"] += snap.get("wire_delta_bytes_saved", 0)
+            delta["frames_skipped"] += snap.get("delta_frames_skipped", 0)
+            delta["tiles_skipped"] += snap.get("delta_tiles_skipped", 0)
+            delta["tiles_total"] += snap.get("delta_tiles_total", 0)
+    if any(delta.values()):
+        for key, val in delta.items():
+            lines.append(f"# TYPE nns_delta_{key} gauge")
+            lines.append(f"nns_delta_{key} {val}")
+
     # 4) attached tracers: the full report, flattened — every
     # Counters/Reservoir trace.py aggregates becomes a series
     emitted_trace_type = False

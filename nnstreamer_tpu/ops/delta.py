@@ -1,0 +1,38 @@
+"""Per-tile change energy for the temporal-delta gate (tensor_delta).
+
+The detector needs one number per ``tile x tile`` block: the mean
+absolute difference between the current frame and the reference, with
+channels collapsed.  That is a pure blocked reduction — exactly the
+shape XLA's reshape+mean lowering is optimal for (same honest-framing
+rule as ops/normalize.py and ops/sparse.py: don't hand-schedule what
+the compiler already fuses), so this is a jitted jnp op, not a Pallas
+kernel.  Inputs must be pre-collapsed to 2-D and pre-padded to tile
+multiples; the host caller (elements/delta.py) owns the padding so the
+jit cache keys stay small.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def tile_error_reference(cur: np.ndarray, ref: np.ndarray,
+                         tile: int) -> np.ndarray:
+    """NumPy oracle: (H/t, W/t) mean-abs-diff per tile. ``cur``/``ref``
+    are 2-D with dims that are multiples of ``tile``."""
+    h, w = cur.shape
+    d = np.abs(cur.astype(np.float32) - ref.astype(np.float32))
+    return d.reshape(h // tile, tile, w // tile, tile).mean(axis=(1, 3))
+
+
+@partial(jax.jit, static_argnums=(2,))
+def tile_error(cur, ref, tile: int):
+    """Device twin of :func:`tile_error_reference` for device-resident
+    chunks — the full frames stay in HBM; only the (H/t, W/t) error
+    grid crosses D2H."""
+    h, w = cur.shape
+    d = jnp.abs(cur.astype(jnp.float32) - ref.astype(jnp.float32))
+    return d.reshape(h // tile, tile, w // tile, tile).mean(axis=(1, 3))

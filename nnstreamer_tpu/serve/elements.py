@@ -21,6 +21,8 @@ import socket
 import threading
 from typing import Dict, Optional, Tuple
 
+import numpy as np
+
 from ..edge import wire
 from ..edge.protocol import MsgKind, recv_msg, send_msg, sever_socket as _sever
 from ..obs import context as _obs_ctx
@@ -108,6 +110,8 @@ class TensorServeSrc(SrcElement):
         self.scheduler: Optional[ServeScheduler] = None
         self._broker_sock: Optional[socket.socket] = None
         self.stats["link_errors"] = 0
+        self.stats.update({"serve_roi_requests": 0, "serve_roi_crops": 0,
+                           "serve_roi_shed": 0})
 
     @property
     def bound_port(self) -> int:
@@ -282,6 +286,10 @@ class TensorServeSrc(SrcElement):
 
     def _admit(self, cid: int, meta, payloads) -> None:
         buf = wire.unpack_buffer(meta, payloads, stats=self.stats)
+        roi = meta.get("delta_roi")
+        if roi and roi.get("rois"):
+            self._admit_roi(cid, buf, meta.get("seq"), roi)
+            return
         self._admit_buf(cid, buf, meta.get("seq"))
 
     def _admit_buf(self, cid: int, buf: Buffer, seq) -> None:
@@ -290,6 +298,64 @@ class TensorServeSrc(SrcElement):
             seq=seq, pts=buf.pts,
             on_result=self._on_result, on_shed=self._on_shed,
             ctx=_obs_ctx.ctx_of(buf))
+
+    # -- ROI-gated admission (tensor_delta mode=roi upstream) --------------
+    def _admit_roi(self, cid: int, buf: Buffer, seq, roi: dict) -> None:
+        """One DATA frame carrying N changed-tile crops becomes N
+        single-crop submissions through the bucketed batcher — the
+        unchanged tiles were never shipped, and here they are never
+        *inferred* either.  One RESULT goes back once every crop's row
+        lands (the echoed ``delta_roi`` block lets the client-side
+        tensor_delta_stitch scatter the rows over its cached canvas)."""
+        crops = buf.chunks[0].host()
+        n = int(crops.shape[0])
+        self.stats.add(serve_roi_requests=1, serve_roi_crops=n)
+        agg = {"rows": [None] * n, "left": n, "settled": False,
+               "lock": threading.Lock(), "roi": roi, "pts": buf.pts,
+               "seq": seq}
+        ctx = _obs_ctx.ctx_of(buf)
+        for k in range(n):
+            self.scheduler.submit(
+                cid, [np.ascontiguousarray(crops[k])],
+                seq=seq, pts=buf.pts,
+                on_result=lambda req, row, k=k, agg=agg:
+                    self._roi_part(cid, agg, k, row),
+                on_shed=lambda req, agg=agg: self._roi_shed(cid, agg),
+                ctx=ctx)
+
+    def _roi_part(self, cid: int, agg: dict, k: int, row) -> None:
+        with agg["lock"]:
+            if agg["settled"]:
+                return  # a sibling crop was shed; the SHED already went
+            agg["rows"][k] = list(row)
+            agg["left"] -= 1
+            if agg["left"] > 0:
+                return
+            agg["settled"] = True
+        rows = agg["rows"]
+        stacked = [np.stack([r[j] for r in rows])
+                   for j in range(len(rows[0]))]
+        with self._clock:
+            entry = self._conns.get(cid)
+        cfg = entry[2] if entry is not None else None
+        reply = Buffer.from_arrays(stacked, pts=agg["pts"])
+        meta, payloads = wire.pack_buffer(reply, cfg, stats=self.stats)
+        meta["client_id"] = cid
+        meta["seq"] = agg["seq"]
+        meta["delta_roi"] = agg["roi"]
+        self._send(cid, MsgKind.RESULT, meta, payloads)
+
+    def _roi_shed(self, cid: int, agg: dict) -> None:
+        """Any shed crop sheds the whole frame: a partial stitch would
+        silently mix epochs. Exactly one SHED answers the request."""
+        with agg["lock"]:
+            if agg["settled"]:
+                return
+            agg["settled"] = True
+        self.stats.inc("serve_roi_shed")
+        self._send(cid, MsgKind.SHED,
+                   {"pts": agg["pts"], "seq": agg["seq"], "client_id": cid,
+                    "retry_after_ms": float(self.retry_after_ms)})
 
     # -- reply side (called by the scheduler's demux) ----------------------
     def _on_result(self, req: Request, row) -> None:

@@ -55,6 +55,17 @@ def _wire_summary(st: Dict[str, Any]) -> Dict[str, Any]:
             out["frames_per_msg"] = round(frames_out / msgs, 2)
     if st.get("wire_frames_in"):
         out["frames_in"] = st["wire_frames_in"]
+    if st.get("wire_delta_keyframes") or st.get("wire_delta_diffs"):
+        # delta codec sender: how much temporal redundancy the link shed
+        out["delta"] = {
+            "keyframes": st.get("wire_delta_keyframes", 0),
+            "diffs": st.get("wire_delta_diffs", 0),
+            "promotions": st.get("wire_delta_promotions", 0),
+            "bytes_saved": st.get("wire_delta_bytes_saved", 0)}
+    if st.get("wire_delta_keyframes_in") or st.get("wire_delta_diffs_in"):
+        out["delta_in"] = {
+            "keyframes": st.get("wire_delta_keyframes_in", 0),
+            "diffs": st.get("wire_delta_diffs_in", 0)}
     return out
 
 

@@ -661,3 +661,53 @@ class TestLlmDisaggRules:
               'custom="role:decode,pool_blocks:64,kv_precision:fp16,'
               'prefix_cache:false" ! tensor_serve_sink')
         assert findings_for(ok, "llm-prefix-cache-lossy-link") == []
+
+
+class TestDeltaRules:
+    def test_delta_without_keyframe_interval_errors(self):
+        bad = (  # pipelint: skip — delta codec with no finite keyframe K
+            f"tensortestsrc caps={CAPS_U8} ! "
+            "edgesink name=e port=0 wire-codec=delta wire-delta-k=0")
+        got = findings_for(bad, "delta-no-keyframe-interval")
+        assert [(f.element, f.severity) for f in got] == \
+            [("e", Severity.ERROR)]
+        assert "wire-delta-k" in got[0].message
+
+    def test_delta_with_finite_k_is_clean(self):
+        ok = (f"tensortestsrc caps={CAPS_U8} ! "
+              "edgesink name=e port=0 wire-codec=delta wire-delta-k=32")
+        assert findings_for(ok, "delta-no-keyframe-interval") == []
+
+    def test_non_delta_codec_ignores_k(self):
+        ok = (f"tensortestsrc caps={CAPS_U8} ! "
+              "edgesink name=e port=0 wire-codec=zlib wire-delta-k=0")
+        assert findings_for(ok, "delta-no-keyframe-interval") == []
+
+    def test_gated_stream_into_trainer_warns(self):
+        bad = (  # pipelint: skip — ROI-skipped stream feeding a trainer
+            f"tensortestsrc caps={CAPS_F32} ! "
+            "tensor_delta name=d mode=gate ! "
+            "tensor_trainer name=tr framework=jax ! fakesink")
+        got = findings_for(bad, "delta-lossy-gate-feeds-trainer")
+        assert [(f.element, f.severity) for f in got] == \
+            [("d", Severity.WARNING)]
+        assert "motion-biased" in got[0].message
+
+    def test_roi_mode_into_trainer_warns(self):
+        bad = (  # pipelint: skip — roi crops feeding a trainer
+            f"tensortestsrc caps={CAPS_F32} ! "
+            "tensor_delta name=d mode=roi ! "
+            "tensor_trainer name=tr framework=jax ! fakesink")
+        got = findings_for(bad, "delta-lossy-gate-feeds-trainer")
+        assert [f.element for f in got] == ["d"]
+
+    def test_mask_mode_into_trainer_is_clean(self):
+        ok = (f"tensortestsrc caps={CAPS_F32} ! "
+              "tensor_delta name=d mode=mask ! "
+              "tensor_trainer name=tr framework=jax ! fakesink")
+        assert findings_for(ok, "delta-lossy-gate-feeds-trainer") == []
+
+    def test_gate_without_trainer_is_clean(self):
+        ok = (f"tensortestsrc caps={CAPS_U8} ! "
+              "tensor_delta name=d mode=gate ! fakesink")
+        assert findings_for(ok, "delta-lossy-gate-feeds-trainer") == []

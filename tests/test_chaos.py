@@ -881,6 +881,95 @@ class TestZeroLossChaos:
         assert tail == [float(i) for i in range(lost, total)]
 
 
+# ------------------------------------------- delta-transport chaos
+
+DELTA_CAPS = ("other/tensors,format=static,num_tensors=1,"
+              "types=float32,dimensions=512")
+
+
+class TestDeltaChaos:
+    """Link kills mid-delta-run (ISSUE 15): a session link negotiated
+    with ``wire-codec=delta`` is severed repeatedly while diffs are in
+    flight. Every resumed connection mints a fresh WireConfig on both
+    ends, so the replay MUST restart from a keyframe — a diff decoded
+    against the pre-kill reference would corrupt frames silently, which
+    is why the gate here is byte-exact content, not just frame counts."""
+
+    @staticmethod
+    def _frames(n):
+        """Moving one-element patch over a 512-float frame: consecutive
+        frames differ in two elements, so diffs genuinely engage (a
+        4-float frame would promote every diff to a keyframe)."""
+        out = []
+        base = np.zeros(512, np.float32)
+        for i in range(n):
+            arr = base.copy()
+            arr[i % 512] = float(i + 1)
+            out.append(arr)
+        return out
+
+    def test_link_kills_mid_delta_replay_from_keyframe(self):
+        port = _free_port()
+        pub = parse_launch(
+            f'appsrc name=in caps="{DELTA_CAPS}" '
+            f'! edgesink name=p port={port} topic=t session=true '
+            'wire-codec=delta wire-delta-k=8 '
+            'coalesce-frames=4 coalesce-ms=10')
+        pub.start()
+        time.sleep(0.2)
+        sub = parse_launch(
+            f'edgesrc name=s dest-port={port} topic=t session=true '
+            'ack-every=4 timeout=15 '
+            '! tensor_fault name=f mode=kill-link target=s every=10 seed=3 '
+            '! appsink name=out')
+        sub.start()
+        time.sleep(0.3)
+        n = 50
+        frames = self._frames(n)
+        for arr in frames:
+            pub["in"].push_buffer(Buffer.from_arrays([arr]))
+            time.sleep(0.01)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and len(sub["out"].buffers) < n:
+            time.sleep(0.05)
+        kills = sub["f"].stats["faults"]
+        ps = pub["p"].stats.snapshot()
+        ss = sub["s"].stats.snapshot()
+        got = [b.chunks[0].host() for b in sub["out"].buffers]
+        pub_err, sub_err = pub._error, sub._error
+        pub["in"].end_stream()
+        pub.wait_eos(timeout=10)
+        pub.stop()
+        sub.stop()
+        assert pub_err is None and sub_err is None  # no aborts
+        assert kills >= 3  # the schedule actually fired
+        # zero loss, exact session accounting on both ends
+        assert ps["session_sent"] == n
+        assert ss["session_delivered"] == n
+        assert ss["session_declared_lost"] == 0
+        assert ps["session_declared_lost"] == 0
+        assert ps["session_resumes"] == kills
+        assert ss["reconnects"] == kills
+        # byte-exact delivery: every frame identical to what was pushed,
+        # in order — the real proof no diff landed on a stale reference
+        assert len(got) == n
+        for want, have in zip(frames, got):
+            assert have.dtype == want.dtype
+            assert have.tobytes() == want.tobytes()
+        # the link really ran in delta mode with diffs in flight...
+        assert ps["wire_delta_diffs"] > 0
+        assert ss["wire_delta_diffs_in"] > 0
+        # ...and every post-kill replay opened with a fresh keyframe
+        # (one per connection: the initial subscribe + one per resume)
+        assert ps["wire_delta_keyframes"] >= kills + 1
+        assert ss["wire_delta_keyframes_in"] >= kills + 1
+        # each kill cost exactly one link error; any extra would mean a
+        # diff arrived for a reference this side no longer held and the
+        # decoder had to tear the link down a second time
+        assert ss["link_errors"] == kills
+        assert ss["link_kills"] == kills
+
+
 # ----------------------------------------- span-tree chaos (ISSUE 12)
 
 class TestSpanTreeChaos:

@@ -1,0 +1,330 @@
+"""Temporal delta compute skip (ISSUE 15): tensor_delta change
+detection (mask/gate/roi), tensor_delta_stitch result reuse, the
+tensor_if custom-condition hook, and the ROI-gated serve path — only
+changed crops are admitted to inference and the stitched output equals
+the full-frame oracle byte-for-byte.
+"""
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import Buffer, parse_launch
+from nnstreamer_tpu.elements.delta import TensorDelta, TensorDeltaStitch
+from nnstreamer_tpu.filters import register_custom_easy
+from nnstreamer_tpu.pipeline.events import FlushEvent, SegmentEvent
+from nnstreamer_tpu.tensors.buffer import Chunk
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _frames(n, shape=(16, 16, 3), patch=8, dtype=np.uint8, seed=0,
+            move_every=1):
+    """Deterministic moving-patch stream; move_every>1 repeats frames."""
+    rng = np.random.default_rng(seed)
+    cur = rng.integers(0, 255, shape, dtype, endpoint=True)
+    out = [cur.copy()]
+    for i in range(1, n):
+        if i % move_every == 0:
+            cur = cur.copy()
+            y = int(rng.integers(0, shape[0] - patch + 1))
+            x = int(rng.integers(0, shape[1] - patch + 1))
+            cur[y:y + patch, x:x + patch] = rng.integers(
+                0, 255, (patch, patch) + shape[2:], dtype, endpoint=True)
+        out.append(cur.copy())
+    return out
+
+
+def _feed(el, arr, pts=None):
+    return el.transform(Buffer([Chunk(np.asarray(arr))], pts=pts))
+
+
+class TestTensorDelta:
+    def test_first_frame_goes_out_full(self):
+        d = TensorDelta(mode="gate")
+        out = _feed(d, np.zeros((8, 8), np.float32))
+        assert out is not None
+        assert out.extras["delta_full"] == 1
+        assert out.extras["delta_changed"] is True
+        assert d.stats["delta_keyframes"] == 1
+
+    def test_gate_drops_static_frames(self):
+        d = TensorDelta(mode="gate", tile=4)
+        a = np.arange(64, dtype=np.float32).reshape(8, 8)
+        assert _feed(d, a) is not None          # keyframe
+        assert _feed(d, a.copy()) is None       # static: gated
+        assert _feed(d, a.copy()) is None
+        b = a.copy()
+        b[0, 0] += 5
+        out = _feed(d, b)                       # motion: passes
+        assert out is not None and out.extras["delta_changed"] is True
+        st = d.stats.snapshot()
+        assert st["delta_frames_skipped"] == 2
+        assert st["delta_tiles_total"] == 3 * 4  # 3 detected frames, 2x2 grid
+        assert st["delta_tiles_skipped"] == 2 * 4 + 3
+
+    def test_threshold_suppresses_small_motion(self):
+        d = TensorDelta(mode="gate", tile=8, threshold=10.0)
+        a = np.full((8, 8), 100.0, np.float32)
+        assert _feed(d, a) is not None
+        b = a.copy()
+        b[0, 0] += 1.0  # mean tile energy 1/64 << threshold
+        assert _feed(d, b) is None
+        c = a.copy()
+        c[:] += 20.0    # energy 20 > threshold
+        assert _feed(d, c) is not None
+
+    def test_hold_forces_periodic_full_frames(self):
+        d = TensorDelta(mode="gate", hold=3)
+        a = np.zeros((4, 4), np.float32)
+        got = [_feed(d, a.copy()) is not None for _ in range(7)]
+        # every 3rd frame is a forced keyframe, statics between are gated
+        assert got == [True, False, False, True, False, False, True]
+        assert d.stats["delta_keyframes"] == 3
+
+    def test_segment_and_flush_reset_reference(self):
+        d = TensorDelta(mode="gate")
+        a = np.ones((4, 4), np.float32)
+        assert _feed(d, a) is not None
+        assert _feed(d, a.copy()) is None
+        d.handle_event(None, SegmentEvent())
+        assert _feed(d, a.copy()) is not None  # fresh reference after reset
+        assert _feed(d, a.copy()) is None
+        d.handle_event(None, FlushEvent())
+        assert _feed(d, a.copy()) is not None
+
+    def test_layout_change_forces_full_frame(self):
+        d = TensorDelta(mode="gate")
+        assert _feed(d, np.zeros((4, 4), np.float32)) is not None
+        assert _feed(d, np.zeros((4, 4), np.float32)) is None
+        out = _feed(d, np.zeros((2, 8), np.float32))  # new shape
+        assert out is not None and out.extras["delta_full"] == 1
+
+    def test_mask_mode_annotates_never_drops(self):
+        d = TensorDelta(mode="mask", tile=4)
+        a = np.zeros((8, 8), np.float32)
+        assert _feed(d, a).extras["delta_full"] == 1
+        out = _feed(d, a.copy())
+        assert out is not None  # static frame still passes in mask mode
+        assert out.extras["delta_changed"] is False
+        assert not out.extras["delta_mask"].any()
+        b = a.copy()
+        b[0, 0] = 9.0
+        out = _feed(d, b)
+        assert out.extras["delta_changed"] is True
+        assert out.extras["delta_mask"].sum() == 1
+        assert out.extras["delta_grid"] == (2, 2)
+
+    def test_roi_mode_ships_only_changed_tiles(self):
+        d = TensorDelta(mode="roi", tile=8)
+        frames = _frames(2, shape=(16, 16, 3), patch=8)
+        _feed(d, frames[0])
+        out = _feed(d, frames[1])
+        assert out is not None
+        crops = out.chunks[0].host()
+        rois = out.extras["delta_rois"]
+        assert crops.shape[1:] == (8, 8, 3)
+        assert 1 <= crops.shape[0] <= 4 and len(rois) == crops.shape[0]
+        for k, (i, j) in enumerate(rois):
+            np.testing.assert_array_equal(
+                crops[k], frames[1][i * 8:(i + 1) * 8, j * 8:(j + 1) * 8])
+
+    def test_roi_ragged_edges_zero_padded(self):
+        d = TensorDelta(mode="roi", tile=8)
+        a = np.zeros((12, 12), np.float32)  # ragged 8-tiles at the edges
+        _feed(d, a)
+        b = a.copy()
+        b[10, 10] = 7.0  # bottom-right ragged tile
+        out = _feed(d, b)
+        crops = out.chunks[0].host()
+        assert crops.shape == (1, 8, 8, 1)
+        np.testing.assert_array_equal(crops[0, :4, :4, 0], b[8:, 8:])
+        assert (crops[0, 4:, :, 0] == 0).all()  # pad area
+        assert out.extras["delta_shape"] == (12, 12)
+
+    def test_device_detection_matches_host(self):
+        """device=true tile energies agree with the host path, so the
+        same frames are gated either way."""
+        import jax
+        frames = _frames(6, shape=(32, 32, 3), patch=8, move_every=2)
+        host = TensorDelta(mode="gate", tile=8)
+        dev = TensorDelta(mode="gate", tile=8, device=True)
+        for f in frames:
+            h = _feed(host, f)
+            g = dev.transform(Buffer([Chunk(jax.device_put(f))]))
+            assert (h is None) == (g is None)
+        assert host.stats.snapshot()["delta_frames_skipped"] == \
+            dev.stats.snapshot()["delta_frames_skipped"] > 0
+
+    def test_unknown_mode_rejected(self):
+        with pytest.raises(ValueError, match="unknown mode"):
+            TensorDelta(mode="bogus")
+
+
+class TestTensorDeltaStitch:
+    def test_roi_stitch_equals_full_frame(self):
+        """detector → stitch with no model in between is the identity:
+        the stitched canvas equals the live frame byte-for-byte."""
+        det = TensorDelta(mode="roi", tile=8, threshold=0.0)
+        st = TensorDeltaStitch()
+        frames = _frames(8, shape=(24, 24, 3), patch=8, seed=3)
+        for f in frames:
+            out = det.transform(Buffer([Chunk(f)]))
+            if out is None:
+                continue  # fully static frame: canvas already equals f
+            got = st.transform(out)
+            np.testing.assert_array_equal(got.chunks[0].host(), f)
+            assert "delta_rois" not in got.extras
+        assert st.stats["delta_stitched"] > 0
+
+    def test_scaled_model_head(self):
+        """A model that halves the crop (8→4 per tile): the canvas
+        scales with it and skipped regions keep their last output."""
+        det = TensorDelta(mode="roi", tile=8)
+        st = TensorDeltaStitch()
+        frames = _frames(5, shape=(16, 16, 3), patch=8, seed=5)
+        shrink = lambda c: c[:, ::2, ::2, :]  # noqa: E731
+
+        def oracle(f):
+            return f.reshape(2, 8, 2, 8, 3)[:, ::2, :, ::2].reshape(
+                -1, 4, 4, 3)
+
+        canvases = []
+        for f in frames:
+            out = det.transform(Buffer([Chunk(f)]))
+            if out is None:
+                canvases.append(canvases[-1])
+                continue
+            if "delta_rois" in out.extras:
+                crops = out.chunks[0].host()
+                out = out.with_chunks([Chunk(np.ascontiguousarray(
+                    shrink(crops)))])
+            else:  # full frame: model output at half resolution
+                full = out.chunks[0].host()
+                out = out.with_chunks([Chunk(np.ascontiguousarray(
+                    full[::2, ::2, :]))])
+            got = st.transform(out).chunks[0].host()
+            assert got.shape == (8, 8, 3)
+            np.testing.assert_array_equal(got, f[::2, ::2, :])
+            canvases.append(got.copy())
+
+    def test_full_frame_refreshes_canvas_after_layout_change(self):
+        st = TensorDeltaStitch()
+        a = np.arange(64, dtype=np.float32).reshape(8, 8)
+        got = st.transform(Buffer([Chunk(a)]))
+        np.testing.assert_array_equal(got.chunks[0].host(), a)
+        b = np.zeros((4, 4), np.float32)  # new layout, full frame
+        got = st.transform(Buffer([Chunk(b)]))
+        np.testing.assert_array_equal(got.chunks[0].host(), b)
+
+
+CAPS_IMG = ('other/tensors,format=static,num_tensors=1,'
+            'types=(string)float32,dimensions=(string)3:16:16')
+
+
+class TestDeltaPipelines:
+    def test_gate_skips_filter_invokes(self):
+        """A static stream behind tensor_delta mode=gate reaches the
+        filter only on keyframes — the compute skip is real."""
+        invokes = []
+        register_custom_easy("delta_count",
+                             lambda x: (invokes.append(1), x * 2)[1])
+        pipe = parse_launch(
+            f'appsrc name=in caps="{CAPS_IMG}" '
+            '! tensor_delta name=d mode=gate tile=8 '
+            '! tensor_filter framework=custom-easy model=delta_count '
+            '! appsink name=out')
+        pipe.start()
+        frame = np.random.default_rng(0).standard_normal(
+            (16, 16, 3)).astype(np.float32)
+        for _ in range(6):  # one keyframe + 5 statics
+            pipe["in"].push_buffer(Buffer.from_arrays([frame.copy()]))
+        pipe["in"].end_stream()
+        pipe.wait_eos(timeout=10)
+        stats = pipe["d"].stats.snapshot()
+        pipe.stop()
+        assert len(pipe["out"].buffers) == 1  # only the keyframe came out
+        assert len(invokes) == 1              # and only it was inferred
+        assert stats["delta_frames_skipped"] == 5
+
+    def test_mask_mode_feeds_tensor_if(self):
+        """mask mode + the registered delta_changed custom condition:
+        tensor_if SKIPs unchanged frames without tensor_delta dropping
+        anything itself."""
+        pipe = parse_launch(
+            f'appsrc name=in caps="{CAPS_IMG}" '
+            '! tensor_delta name=d mode=mask tile=8 '
+            '! tensor_if name=i compared-value=CUSTOM '
+            'compared-value-option=delta_changed then=PASSTHROUGH '
+            'else=SKIP ! appsink name=out')
+        pipe.start()
+        frames = _frames(6, shape=(16, 16, 3), dtype=np.uint8,
+                         move_every=3, seed=2)
+        for f in frames:
+            pipe["in"].push_buffer(Buffer.from_arrays(
+                [f.astype(np.float32)]))
+        pipe["in"].end_stream()
+        pipe.wait_eos(timeout=10)
+        got = len(pipe["out"].buffers)
+        pipe.stop()
+        # frames 0 (keyframe), 3 (patch moved) pass; statics are skipped
+        assert got == 2
+
+    def test_roi_serve_path_only_changed_crops_inferred(self):
+        """End to end: detector → query client → bucketed serve batcher
+        → stitch. Only changed crops cross the wire and the filter; the
+        stitched stream still equals the full-frame oracle exactly."""
+        crops_seen = []
+        register_custom_easy(
+            "delta_roi_scale",
+            lambda x: (crops_seen.append(np.asarray(x).shape), x * 3)[1])
+        port = _free_port()
+        server = parse_launch(
+            f'tensor_serve_src name=src port={port} id=90 buckets=1,2,4 '
+            'max-wait-ms=2 '
+            '! tensor_filter framework=custom-easy model=delta_roi_scale '
+            '! tensor_serve_sink id=90')
+        server.start()
+        time.sleep(0.2)
+        client = parse_launch(
+            f'appsrc name=in caps="{CAPS_IMG}" '
+            '! tensor_delta name=d mode=roi tile=8 '
+            f'! tensor_query_client name=qc port={port} timeout=15 '
+            '! tensor_delta_stitch name=st ! appsink name=out')
+        client.start()
+        frames = [f.astype(np.float32) for f in _frames(
+            5, shape=(16, 16, 3), patch=8, seed=7)]
+        for f in frames:
+            client["in"].push_buffer(Buffer.from_arrays([f.copy()]))
+        deadline = time.monotonic() + 20
+        while len(client["out"].buffers) < 5 and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+        srv_stats = server["src"].stats.snapshot()
+        det_stats = client["d"].stats.snapshot()
+        client["in"].end_stream()
+        client.stop()
+        server.stop()
+        got = client["out"].buffers
+        assert len(got) == 5
+        for f, b in zip(frames, got):
+            np.testing.assert_array_equal(b.chunks[0].host(), f * 3)
+        # the skip is real: ROI requests carried fewer crops than the
+        # 4-tile grid, and the serve side accounted them
+        assert srv_stats["serve_roi_requests"] == 4  # frames 1-4
+        assert srv_stats["serve_roi_crops"] == \
+            det_stats["delta_tiles_total"] - det_stats["delta_tiles_skipped"]
+        assert srv_stats["serve_roi_crops"] < 4 * 4
+        assert srv_stats["serve_roi_shed"] == 0
+        # every inferred row was a crop, never a full frame — and the
+        # batcher stacked exactly the admitted crops, no more
+        roi_rows = sum(s[0] for s in crops_seen if s[-3:] == (8, 8, 3))
+        assert roi_rows == srv_stats["serve_roi_crops"]
+        assert all(s[-3:] in ((8, 8, 3), (16, 16, 3)) for s in crops_seen)

@@ -59,7 +59,10 @@ class TestCodecRoundTrip:
         cfg = wire.WireConfig(codec)
         meta, payloads = wire.pack_buffer(
             Buffer.from_arrays([arr], pts=7), cfg)
-        out = wire.unpack_buffer(meta, payloads)
+        # rx mirrors the receiving end of the link (delta keeps its
+        # reference state there; the other codecs ignore it)
+        out = wire.unpack_buffer(meta, payloads,
+                                 cfg=wire.accept(cfg.to_meta()))
         got = out.chunks[0].host()
         assert got.dtype == arr.dtype and got.shape == arr.shape
         np.testing.assert_array_equal(np.asarray(got).view(np.uint8),
@@ -72,7 +75,8 @@ class TestCodecRoundTrip:
         arr = np.empty((0, 4), np.float32)
         cfg = wire.WireConfig(codec)
         meta, payloads = wire.pack_buffer(Buffer.from_arrays([arr]), cfg)
-        got = wire.unpack_buffer(meta, payloads).chunks[0].host()
+        got = wire.unpack_buffer(
+            meta, payloads, cfg=wire.accept(cfg.to_meta())).chunks[0].host()
         assert got.shape == (0, 4) and got.dtype == np.float32
 
     @pytest.mark.parametrize("codec", wire.CODECS)
@@ -82,7 +86,8 @@ class TestCodecRoundTrip:
         assert not arr.flags.c_contiguous
         cfg = wire.WireConfig(codec)
         meta, payloads = wire.pack_buffer(Buffer.from_arrays([arr]), cfg)
-        got = wire.unpack_buffer(meta, payloads).chunks[0].host()
+        got = wire.unpack_buffer(
+            meta, payloads, cfg=wire.accept(cfg.to_meta())).chunks[0].host()
         np.testing.assert_array_equal(got, arr)
 
     def test_compressible_actually_shrinks(self):
@@ -135,6 +140,325 @@ class TestPrecisionDowncast:
         assert "wire_dtype" not in meta["tensors"][0]
         got = wire.unpack_buffer(meta, payloads).chunks[0].host()
         np.testing.assert_array_equal(got, arr)
+
+
+# -- delta codec (temporal keyframe + sparse diff) ----------------------------
+
+
+def _motion_frames(n, dtype=np.uint8, shape=(24, 24, 3), patch=6, seed=0):
+    """A deterministic ~low-motion stream: a fixed base frame with one
+    small patch redrawn per frame — the traffic the delta codec is for."""
+    rng = np.random.default_rng(seed)
+    if np.issubdtype(np.dtype(dtype), np.floating) or "float" in str(dtype):
+        cur = rng.standard_normal(shape).astype(np.float32).astype(dtype)
+        draw = lambda s: rng.standard_normal(s).astype(  # noqa: E731
+            np.float32).astype(dtype)
+    else:
+        info = np.iinfo(dtype)
+        cur = rng.integers(info.min, info.max, shape, dtype=dtype)
+        draw = lambda s: rng.integers(  # noqa: E731
+            info.min, info.max, s, dtype=dtype)
+    frames = [cur.copy()]
+    for _ in range(n - 1):
+        cur = cur.copy()
+        y = int(rng.integers(0, shape[0] - patch))
+        x = int(rng.integers(0, shape[1] - patch))
+        cur[y:y + patch, x:x + patch] = draw((patch, patch) + shape[2:])
+        frames.append(cur.copy())
+    return frames
+
+
+def _delta_link(delta_k=4, precision="none"):
+    """(sender cfg, receiver cfg) for one negotiated delta link, minted
+    exactly like edgesink negotiate + edgesrc accept."""
+    tx = wire.negotiate(wire.advertise(), codec="delta",
+                        precision=precision, delta_k=delta_k)
+    assert tx is not None and tx.codec == wire.CODEC_DELTA
+    return tx, wire.accept(tx.to_meta())
+
+
+class TestDeltaCodec:
+    """wire-codec=delta unit layer: keyframe/diff stream round trips,
+    cadence, promotions, epoch safety, precision composition, batches."""
+
+    @pytest.mark.parametrize("ttype", list(TensorType))
+    def test_stream_round_trip_all_dtypes(self, ttype):
+        tx, rx = _delta_link(delta_k=4)
+        frames = _motion_frames(9, dtype=ttype.np_dtype, seed=int(ttype))
+        stats = Counters()
+        for f in frames:
+            meta, payloads = wire.pack_buffer(Buffer.from_arrays([f]), tx,
+                                              stats=stats)
+            got = wire.unpack_buffer(meta, payloads, cfg=rx)
+            out = got.chunks[0].host()
+            assert out.dtype == f.dtype and out.shape == f.shape
+            np.testing.assert_array_equal(np.asarray(out).view(np.uint8),
+                                          np.asarray(f).view(np.uint8))
+            assert out.flags.writeable
+        snap = stats.snapshot()
+        assert snap["wire_delta_diffs"] > 0  # the codec actually engaged
+
+    def test_keyframe_cadence(self):
+        tx, rx = _delta_link(delta_k=4)
+        frames = _motion_frames(9)
+        stats = Counters()
+        keys = []
+        for f in frames:
+            meta, payloads = wire.pack_buffer(Buffer.from_arrays([f]), tx,
+                                              stats=stats)
+            keys.append(bool(meta["delta"].get("k")))
+            wire.unpack_buffer(meta, payloads, cfg=rx)
+        # K D D D K D D D K: a keyframe every delta_k frames, no drift
+        assert keys == [True, False, False, False, True,
+                        False, False, False, True]
+        snap = stats.snapshot()
+        assert snap["wire_delta_keyframes"] == 3
+        assert snap["wire_delta_diffs"] == 6
+        assert snap["wire_delta_promotions"] == 0
+        assert snap["wire_delta_bytes_saved"] > 0
+
+    def test_diffs_actually_shrink_the_wire(self):
+        """~6% motion on an incompressible base: per-frame zlib finds
+        nothing (adaptive skip territory) but the temporal diff sheds
+        the static 94%."""
+        tx, rx = _delta_link(delta_k=0)  # no scheduled rekey: pure diffs
+        frames = _motion_frames(8, shape=(32, 32, 3), patch=8)
+        sizes = []
+        for f in frames:
+            meta, payloads = wire.pack_buffer(Buffer.from_arrays([f]), tx)
+            sizes.append(sum(len(bytes(p) if not isinstance(p, np.ndarray)
+                                 else p.tobytes()) for p in payloads))
+            wire.unpack_buffer(meta, payloads, cfg=rx)
+        dense = frames[0].nbytes
+        assert sizes[0] >= dense * 0.9       # keyframe ships ~dense
+        for s in sizes[1:]:                   # diffs ship ~the patch
+            assert s < dense * 0.5
+
+    def test_layout_change_forces_keyframe(self):
+        tx, rx = _delta_link(delta_k=32)
+        stats = Counters()
+        a = np.arange(48, dtype=np.float32).reshape(6, 8)
+        b = a.copy()
+        b[0, 0] += 1  # one element moved: a genuine diff frame
+        for arr in (a, b, a.reshape(8, 6)):  # 3rd frame: new layout
+            meta, payloads = wire.pack_buffer(Buffer.from_arrays([arr]), tx,
+                                              stats=stats)
+            got = wire.unpack_buffer(meta, payloads, cfg=rx)
+            np.testing.assert_array_equal(got.chunks[0].host(), arr)
+        snap = stats.snapshot()
+        assert snap["wire_delta_keyframes"] == 2  # fresh link + layout
+        assert snap["wire_delta_promotions"] == 1  # counted as promotion
+
+    def test_unbeatable_diff_promotes_to_keyframe(self):
+        """Every pixel changes: the sparse diff costs more than the
+        dense frame, so the sender promotes instead of shipping it."""
+        tx, rx = _delta_link(delta_k=0)
+        rng = np.random.default_rng(3)
+        stats = Counters()
+        for _ in range(3):  # fully-redrawn noise every frame
+            arr = rng.integers(0, 255, (16, 16, 3), np.uint8)
+            meta, payloads = wire.pack_buffer(Buffer.from_arrays([arr]), tx,
+                                              stats=stats)
+            assert meta["delta"].get("k") == 1
+            got = wire.unpack_buffer(meta, payloads, cfg=rx)
+            np.testing.assert_array_equal(got.chunks[0].host(), arr)
+        snap = stats.snapshot()
+        assert snap["wire_delta_keyframes"] == 3
+        assert snap["wire_delta_promotions"] == 2  # all but the first
+        assert snap["wire_delta_diffs"] == 0
+
+    def test_diff_against_missing_reference_raises(self):
+        """A diff must never silently patch the wrong baseline: a
+        receiver without the sender's reference epoch raises (the link
+        layer turns that into a reconnect + fresh keyframe)."""
+        tx, _rx = _delta_link(delta_k=0)
+        frames = _motion_frames(2)
+        key = wire.pack_buffer(Buffer.from_arrays([frames[0]]), tx)
+        diff = wire.pack_buffer(Buffer.from_arrays([frames[1]]), tx)
+        fresh = wire.accept(tx.to_meta())  # never saw the keyframe
+        with pytest.raises(ValueError, match="reference"):
+            wire.unpack_buffer(diff[0], diff[1], cfg=fresh)
+        # and a receiver holding a DIFFERENT epoch's reference raises too
+        other = wire.accept(tx.to_meta())
+        rekey = wire.negotiate(wire.advertise(), codec="delta", delta_k=0)
+        meta2, p2 = wire.pack_buffer(Buffer.from_arrays([frames[0]]), rekey)
+        meta2["delta"]["e"] = 99
+        wire.unpack_buffer(meta2, p2, cfg=other)
+        with pytest.raises(ValueError, match="epoch"):
+            wire.unpack_buffer(diff[0], diff[1], cfg=other)
+        del key
+
+    def test_unpack_without_cfg_raises(self):
+        tx, _rx = _delta_link()
+        meta, payloads = wire.pack_buffer(
+            Buffer.from_arrays([np.zeros((4, 4), np.uint8)]), tx)
+        with pytest.raises(ValueError, match="negotiate"):
+            wire.unpack_buffer(meta, payloads)
+        with pytest.raises(ValueError, match="negotiate"):
+            wire.unpack_buffer(meta, payloads,
+                               cfg=wire.WireConfig(wire.CODEC_ZLIB))
+
+    def test_precision_composes_under_delta(self):
+        """bf16 downcast under delta: references live in wire precision
+        on both ends, so diffs are exact in the wire domain and the
+        delivered stream equals the downcast-upcast of the original."""
+        tx, rx = _delta_link(delta_k=4, precision="bf16")
+        frames = _motion_frames(6, dtype=np.float32)
+        stats = Counters()
+        import jax.numpy as jnp
+        for f in frames:
+            meta, payloads = wire.pack_buffer(Buffer.from_arrays([f]), tx,
+                                              stats=stats)
+            got = wire.unpack_buffer(meta, payloads, cfg=rx)
+            arr = got.chunks[0].host()
+            assert arr.dtype == np.float32
+            want = np.asarray(jnp.asarray(f).astype(jnp.bfloat16)
+                              ).astype(np.float32)
+            np.testing.assert_array_equal(arr, want)
+        assert stats.snapshot()["wire_delta_diffs"] > 0
+
+    def test_zero_size_and_multi_chunk_stream(self):
+        tx, rx = _delta_link(delta_k=3)
+        a = np.empty((0, 4), np.float32)
+        b = np.arange(12, dtype=np.int16).reshape(3, 4)
+        for i in range(5):
+            buf = Buffer.from_arrays([a, b + i], pts=i)
+            meta, payloads = wire.pack_buffer(buf, tx)
+            got = wire.unpack_buffer(meta, payloads, cfg=rx)
+            assert got.pts == i
+            assert got.chunks[0].host().shape == (0, 4)
+            np.testing.assert_array_equal(got.chunks[1].host(), b + i)
+
+    def test_batch_round_trip_with_midbatch_keyframe(self):
+        """A coalesced DATA_BATCH spanning a K rollover: frames 0-5
+        with delta_k=4 put a keyframe mid-batch; every frame must
+        decode byte-exact with per-frame meta restored."""
+        tx, rx = _delta_link(delta_k=4)
+        frames = _motion_frames(6)
+        bufs = [Buffer.from_arrays([f], pts=i * 10)
+                for i, f in enumerate(frames)]
+        stats = Counters()
+        meta, payloads = wire.pack_batch(bufs, tx, stats=stats,
+                                         seqs=list(range(1, 7)))
+        assert meta["delta"]["ks"] == [1, 0, 0, 0, 1, 0]
+        out = wire.unpack_batch(meta, payloads, cfg=rx)
+        assert len(out) == 6
+        for i, (f, b) in enumerate(zip(frames, out)):
+            np.testing.assert_array_equal(b.chunks[0].host(), f)
+            assert b.pts == i * 10
+            assert b.extras["seq"] == i + 1
+        snap = stats.snapshot()
+        assert snap["wire_delta_keyframes"] == 2
+        assert snap["wire_delta_diffs"] == 4
+
+    def test_batch_then_single_share_reference_state(self):
+        """The link reference evolves across message kinds: a DATA
+        frame after a DATA_BATCH diffs against the batch's last frame."""
+        tx, rx = _delta_link(delta_k=0)
+        frames = _motion_frames(4)
+        meta, payloads = wire.pack_batch(
+            [Buffer.from_arrays([f]) for f in frames[:3]], tx)
+        for b, f in zip(wire.unpack_batch(meta, payloads, cfg=rx),
+                        frames[:3]):
+            np.testing.assert_array_equal(b.chunks[0].host(), f)
+        meta, payloads = wire.pack_buffer(Buffer.from_arrays([frames[3]]),
+                                          tx)
+        assert "k" not in meta["delta"]  # a diff, not a keyframe
+        got = wire.unpack_buffer(meta, payloads, cfg=rx)
+        np.testing.assert_array_equal(got.chunks[0].host(), frames[3])
+
+
+class TestDeltaNegotiation:
+    """Delta requires per-link receiver state, so it is only chosen by
+    the accepting side's own request — and old peers fall back cleanly
+    in both directions."""
+
+    def test_peer_wish_never_adopted_without_local_request(self):
+        cfg = wire.negotiate(wire.advertise(codec="delta"))
+        assert cfg is not None and cfg.codec == wire.CODEC_RAW
+
+    def test_local_request_against_old_peer_falls_back(self):
+        old = wire.advertise()
+        old["codecs"] = ["raw", "zlib", "shuffle-zlib"]  # pre-delta build
+        cfg = wire.negotiate(old, codec="delta")
+        assert cfg is not None and cfg.codec == wire.CODEC_RAW
+
+    def test_local_request_against_v1_peer_is_plain(self):
+        assert wire.negotiate(None, codec="delta") is None
+        assert wire.negotiate({"no": "v"}, codec="delta") is None
+
+    def test_delta_k_rides_the_ack(self):
+        tx = wire.negotiate(wire.advertise(), codec="delta", delta_k=7)
+        assert tx.to_meta()["delta_k"] == 7
+        rx = wire.accept(tx.to_meta())
+        assert rx.codec == wire.CODEC_DELTA and rx.delta_k == 7
+
+    def test_non_delta_meta_has_no_delta_k(self):
+        assert "delta_k" not in wire.WireConfig(wire.CODEC_ZLIB).to_meta()
+
+
+class TestDeltaPipelines:
+    """Element layer: edgesink wire-codec=delta → edgesrc, byte parity
+    with the delta-off control arm."""
+
+    CAPS_BIG = ('other/tensors,format=static,num_tensors=1,'
+                'types=(string)float32,dimensions=(string)512')
+
+    def _run(self, extra=""):
+        port = _free_port()
+        pub = parse_launch(
+            f'appsrc name=in caps="{self.CAPS_BIG}" '
+            f'! edgesink name=p port={port} topic=t {extra}')
+        pub.start()
+        time.sleep(0.2)
+        sub = parse_launch(
+            f'edgesrc name=s dest-port={port} topic=t timeout=15 '
+            '! appsink name=out')
+        sub.start()
+        time.sleep(0.3)
+        rng = np.random.default_rng(11)
+        frames = []
+        cur = rng.standard_normal(512).astype(np.float32)
+        for i in range(10):
+            cur = cur.copy()
+            cur[(i * 13) % 512] = float(i)  # one element moves per frame
+            frames.append(cur.copy())
+            pub["in"].push_buffer(Buffer.from_arrays([cur], pts=i))
+        deadline = time.monotonic() + 15
+        while len(sub["out"].buffers) < 10 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        pub_stats = pub["p"].stats.snapshot()
+        sub_stats = sub["s"].stats.snapshot()
+        pub["in"].end_stream()
+        sub.wait_eos(timeout=15)
+        sub.stop()
+        pub.stop()
+        got = [(b.pts, b.chunks[0].host().copy())
+               for b in sub["out"].buffers]
+        return frames, got, pub_stats, sub_stats
+
+    def test_delta_link_is_byte_identical_to_control(self):
+        frames, got, ps, ss = self._run("wire-codec=delta wire-delta-k=4")
+        control_frames, control, _, _ = self._run("")
+        assert len(got) == 10 and len(control) == 10
+        for i, (f, (pts, arr)) in enumerate(zip(frames, got)):
+            assert pts == i
+            np.testing.assert_array_equal(arr, f)
+        for i, (f, (pts, arr)) in enumerate(zip(control_frames, control)):
+            np.testing.assert_array_equal(arr, f)
+        # the delta arm really spoke delta
+        assert ps["wire_delta_keyframes"] >= 1
+        assert ps["wire_delta_diffs"] > 0
+        assert ss["wire_delta_diffs_in"] == ps["wire_delta_diffs"]
+
+    def test_delta_link_with_coalescing(self):
+        frames, got, ps, ss = self._run(
+            "wire-codec=delta wire-delta-k=4 coalesce-frames=4 "
+            "coalesce-ms=20")
+        assert [pts for pts, _ in got] == list(range(10))
+        for f, (_pts, arr) in zip(frames, got):
+            np.testing.assert_array_equal(arr, f)
+        assert ps["wire_delta_diffs"] > 0
 
 
 # -- negotiation matrix -------------------------------------------------------

@@ -1,0 +1,314 @@
+#!/usr/bin/env python
+"""Delta-transport byte-parity gate: delta links must be lossless.
+
+Runs a built-in suite of frame streams (motion, static, full-change
+promotion, mid-stream layout change, multi-tensor, zero-size, bitwise
+NaN/-0.0 payloads, lossy-precision composition) through a negotiated
+``wire-codec=delta`` link — single-frame and DATA_BATCH paths — and
+byte-compares every decoded frame against (a) the source bytes and
+(b) a raw control link carrying the same stream. A live end-to-end
+scenario (edgesink -> socket -> edgesrc, delta vs control) covers the
+element layer too.
+
+The fallback contract is checked explicitly: a peer whose codec list
+lacks ``delta`` must negotiate down to raw and receive bytes identical
+to a plain raw link, and a v1 peer (no wire block) still gets plain v1
+framing.
+
+Exit status is nonzero iff any stream diverges — or if the suite was
+vacuous (no scenario actually shipped a sparse diff: a gate that only
+ever exercised keyframes proves nothing).
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+from typing import Callable, List, Tuple
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+import numpy as np  # noqa: E402
+
+from nnstreamer_tpu.edge import wire  # noqa: E402
+from nnstreamer_tpu.tensors.buffer import Buffer  # noqa: E402
+from nnstreamer_tpu.utils.atomic import Counters  # noqa: E402
+
+DELTA_K = 4  # short cadence so every stream crosses a keyframe boundary
+
+
+# -- built-in streams --------------------------------------------------
+
+def _motion(n=12, shape=(64, 64, 3), dtype=np.float32):
+    """A patch marches across the frame: small genuine diffs."""
+    rng = np.random.default_rng(7)
+    base = rng.standard_normal(shape).astype(dtype)
+    out = []
+    for i in range(n):
+        f = base.copy()
+        f.reshape(-1)[(i * 97) % f.size] = dtype(i + 1)
+        out.append(Buffer.from_arrays([f]))
+    return out
+
+
+def _static(n=8):
+    f = np.arange(4096, dtype=np.uint8).reshape(64, 64)
+    return [Buffer.from_arrays([f.copy()]) for _ in range(n)]
+
+
+def _full_change(n=6):
+    """Every element moves every frame: diffs cannot win, the encoder
+    must promote to keyframes — losslessly."""
+    rng = np.random.default_rng(11)
+    return [Buffer.from_arrays([rng.standard_normal((32, 32))
+                                .astype(np.float32)]) for _ in range(n)]
+
+
+def _layout_change():
+    a = np.zeros((16, 16), np.float32)
+    b = np.zeros((8, 32), np.float32)
+    out = []
+    for i in range(4):
+        f = a.copy()
+        f[0, 0] = i
+        out.append(Buffer.from_arrays([f]))
+    for i in range(4):
+        f = b.copy()
+        f[0, 1] = i
+        out.append(Buffer.from_arrays([f]))
+    return out
+
+
+def _multi_tensor(n=8):
+    out = []
+    img = np.zeros((24, 24, 3), np.float32)
+    lab = np.zeros(16, np.int32)
+    for i in range(n):
+        a, b = img.copy(), lab.copy()
+        a[i % 24, 0, 0] = i + 1
+        b[i % 16] = i
+        out.append(Buffer.from_arrays([a, b]))
+    return out
+
+
+def _zero_size(n=6):
+    z = np.zeros((0, 4), np.float32)
+    f = np.zeros(256, np.float32)
+    out = []
+    for i in range(n):
+        g = f.copy()
+        g[i] = i + 1
+        out.append(Buffer.from_arrays([z.copy(), g]))
+    return out
+
+
+def _bitwise(n=6):
+    """NaN / -0.0 / inf payloads: parity must be bitwise, not ==."""
+    f = np.full(512, np.nan, np.float32)
+    f[::2] = -0.0
+    f[1::4] = np.inf
+    out = []
+    for i in range(n):
+        g = f.copy()
+        g[i] = float(i)
+        out.append(Buffer.from_arrays([g]))
+    return out
+
+
+def _int_motion(n=10):
+    f = np.zeros((48, 48), np.int16)
+    out = []
+    for i in range(n):
+        g = f.copy()
+        g[i % 48, (i * 3) % 48] = i + 1
+        out.append(Buffer.from_arrays([g]))
+    return out
+
+
+BUILTIN: List[Tuple[str, Callable[[], List[Buffer]], str]] = [
+    ("builtin:motion-f32", _motion, "none"),
+    ("builtin:static-u8", _static, "none"),
+    ("builtin:full-change-promotes", _full_change, "none"),
+    ("builtin:layout-change", _layout_change, "none"),
+    ("builtin:multi-tensor", _multi_tensor, "none"),
+    ("builtin:zero-size", _zero_size, "none"),
+    ("builtin:bitwise-nan", _bitwise, "none"),
+    ("builtin:int16-motion", _int_motion, "none"),
+    # lossy precision composed with delta: both arms run bf16, so the
+    # (deterministic) rounding is identical and parity still holds
+    ("builtin:bf16-precision", _motion, "bf16"),
+]
+
+
+# -- link plumbing -----------------------------------------------------
+
+def _link(codec: str, precision: str):
+    """(tx_cfg, rx_cfg) exactly as edgesink/edgesrc mint them: the sink
+    negotiates against the subscriber's advertisement, the source
+    accepts the echoed reply."""
+    tx = wire.negotiate(wire.advertise(), codec=codec, precision=precision,
+                        delta_k=DELTA_K)
+    rx = wire.accept(tx.to_meta())
+    return tx, rx
+
+
+def _bytes_of(buf: Buffer):
+    return tuple((str(np.asarray(c.host()).dtype),
+                  tuple(np.asarray(c.host()).shape),
+                  np.ascontiguousarray(c.host()).tobytes())
+                 for c in buf.chunks)
+
+
+def _ship(frames: List[Buffer], codec: str, precision: str, batch: int,
+          stats: Counters) -> List[Tuple]:
+    """Push the stream through one pack->unpack link, single-frame when
+    batch<=1, DATA_BATCH coalesced otherwise."""
+    tx, rx = _link(codec, precision)
+    out: List[Tuple] = []
+    if batch <= 1:
+        for b in frames:
+            meta, payloads = wire.pack_buffer(b, tx, stats=stats)
+            out.append(_bytes_of(
+                wire.unpack_buffer(meta, payloads, stats=stats, cfg=rx)))
+        return out
+    for i in range(0, len(frames), batch):
+        group = frames[i:i + batch]
+        meta, payloads = wire.pack_batch(
+            group, tx, stats=stats,
+            seqs=[i + k + 1 for k in range(len(group))])
+        for b in wire.unpack_batch(meta, payloads, stats=stats, cfg=rx):
+            out.append(_bytes_of(b))
+    return out
+
+
+def check_stream(name: str, frames: List[Buffer], precision: str,
+                 stats: Counters) -> Tuple[str, str]:
+    """-> (status, detail); status in {delta-ok, FAIL}."""
+    want_src = [_bytes_of(b) for b in frames]
+    for batch, path in ((1, "frame"), (4, "batch")):
+        got_delta = _ship(frames, wire.CODEC_DELTA, precision, batch, stats)
+        got_ctrl = _ship(frames, wire.CODEC_RAW, precision, batch,
+                         Counters())
+        if got_delta != got_ctrl:
+            return "FAIL", f"{path} path: delta bytes differ from control"
+        if precision == "none" and got_delta != want_src:
+            return "FAIL", f"{path} path: delta bytes differ from source"
+    return "delta-ok", f"{len(frames)} frames x2 paths byte-identical"
+
+
+def check_fallback() -> Tuple[str, str]:
+    """Old peers never see delta frames: a codec list without ``delta``
+    negotiates down to raw, and a v1 peer gets plain framing."""
+    old = wire.advertise()
+    old["codecs"] = [c for c in old["codecs"] if c != wire.CODEC_DELTA]
+    cfg = wire.negotiate(old, codec=wire.CODEC_DELTA, delta_k=DELTA_K)
+    if cfg.codec != wire.CODEC_RAW:
+        return "FAIL", f"non-delta peer negotiated {cfg.codec!r}"
+    if wire.negotiate({"v": 1}, codec=wire.CODEC_DELTA) is not None:
+        return "FAIL", "v1 peer was offered a v2 config"
+    buf = _motion(1)[0]
+    meta, payloads = wire.pack_buffer(buf, cfg)
+    meta_raw, payloads_raw = wire.pack_buffer(
+        buf, wire.WireConfig(wire.CODEC_RAW))
+    if [bytes(p) for p in payloads] != [bytes(p) for p in payloads_raw] \
+            or "delta" in meta:
+        return "FAIL", "fallback link's bytes differ from a raw link"
+    return "delta-ok", "non-delta and v1 peers get raw framing"
+
+
+def check_live(timeout: float) -> Tuple[str, str]:
+    """End-to-end element-layer parity: the same stream published over
+    a real socket with wire-codec=delta vs a control run, compared at
+    the subscriber's appsink."""
+    import socket as _socket
+
+    from nnstreamer_tpu.pipeline.parser import parse_launch
+
+    caps = ("other/tensors,format=static,num_tensors=1,"
+            "types=float32,dimensions=512")
+    frames = [np.zeros(512, np.float32) for _ in range(16)]
+    for i, f in enumerate(frames):
+        f[i % 512] = float(i + 1)
+
+    def run(codec: str):
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        pub = parse_launch(
+            f'appsrc name=in caps="{caps}" ! edgesink name=p port={port} '
+            f'topic=t wire-codec={codec} wire-delta-k={DELTA_K}')
+        pub.start()
+        time.sleep(0.2)
+        sub = parse_launch(f'edgesrc name=s dest-port={port} topic=t '
+                           f'timeout=10 ! appsink name=out')
+        sub.start()
+        time.sleep(0.2)
+        for f in frames:
+            pub["in"].push_buffer(Buffer.from_arrays([f.copy()]))
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline \
+                and len(sub["out"].buffers) < len(frames):
+            time.sleep(0.02)
+        got = [_bytes_of(b) for b in sub["out"].buffers]
+        ps = pub["p"].stats.snapshot()
+        pub["in"].end_stream()
+        pub.wait_eos(timeout=5)
+        pub.stop()
+        sub.stop()
+        return got, ps
+
+    got_delta, ps = run(wire.CODEC_DELTA)
+    got_ctrl, _ = run(wire.CODEC_RAW)
+    want = [_bytes_of(Buffer.from_arrays([f])) for f in frames]
+    if got_delta != got_ctrl or got_delta != want:
+        return "FAIL", (f"live link bytes diverge "
+                        f"({len(got_delta)}/{len(got_ctrl)}/{len(want)})")
+    if ps.get("wire_delta_diffs", 0) <= 0:
+        return "FAIL", "live delta link never shipped a diff (vacuous)"
+    return "delta-ok", (f"{len(frames)} frames over a live socket, "
+                        f"{ps['wire_delta_diffs']} diffs, byte-identical")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("-v", "--verbose", action="store_true")
+    ap.add_argument("--timeout", type=float, default=30.0)
+    ap.add_argument("--no-live", action="store_true",
+                    help="skip the socket end-to-end scenario")
+    opts = ap.parse_args(argv)
+
+    counts = {"delta-ok": 0, "FAIL": 0}
+    failures: List[str] = []
+    stats = Counters()
+    checks: List[Tuple[str, Callable[[], Tuple[str, str]]]] = [
+        (name, (lambda g=gen, p=prec, n=name:
+                check_stream(n, g(), p, stats)))
+        for name, gen, prec in BUILTIN]
+    checks.append(("builtin:fallback-raw", check_fallback))
+    if not opts.no_live:
+        checks.append(("builtin:live-link",
+                       lambda: check_live(opts.timeout)))
+    for name, fn in checks:
+        status, detail = fn()
+        counts[status] += 1
+        if status == "FAIL":
+            failures.append(f"{name}: {detail}")
+        if opts.verbose or status == "FAIL":
+            print(f"[{status}] {name}: {detail}")
+    diffs = stats["wire_delta_diffs"]
+    saved = stats["wire_delta_bytes_saved"]
+    print(f"delta-parity: {counts['delta-ok']} scenarios byte-identical, "
+          f"{counts['FAIL']} failures; {diffs} diff frames shipped, "
+          f"{saved} wire bytes saved")
+    if counts["delta-ok"] == 0 or diffs == 0:
+        print("delta-parity: the suite shipped no sparse diffs — "
+              "the gate is vacuous", file=sys.stderr)
+        return 1
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
