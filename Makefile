@@ -9,7 +9,8 @@ SHELL := /bin/bash
 LIB := $(BUILD)/libnnstpu.so
 EXAMPLES := $(BUILD)/custom_passthrough.so $(BUILD)/custom_scaler.so
 
-.PHONY: native clean test check tier1 lint racecheck chaos chaos-zeroloss \
+.PHONY: native clean test check tier1 lint racecheck flowcheck chaos \
+	chaos-zeroloss \
 	chaos-fleet chaos-preempt chaos-llm fuse-parity async-parity \
 	shard-parity delta-parity obs-overhead package
 
@@ -19,7 +20,7 @@ native: $(LIB) $(EXAMPLES)
 # non-slow test suite on the 8-virtual-device CPU mesh
 # (tests/conftest.py forces JAX_PLATFORMS=cpu) + a packaging sanity
 # check.
-check: native lint racecheck
+check: native lint racecheck flowcheck
 	python -m pytest tests/ -q -m 'not slow'
 	python -c "import nnstreamer_tpu as nt; print('import ok:', len(nt.pipeline.registry.element_names()), 'elements')"
 	$(MAKE) fuse-parity
@@ -120,6 +121,16 @@ tier1:
 # in build/racecheck.json for CI artifacts.
 racecheck:
 	env JAX_PLATFORMS=cpu python -m nnstreamer_tpu racecheck nnstreamer_tpu -o build/racecheck.json
+
+# `make flowcheck` = the settlement gate: every acquire (window slot,
+# KV block, accepted socket) must reach a settle on every path, every
+# discarding settle must bump a declared loss counter, and every
+# declared conservation identity must be producible from the counters
+# its module actually increments. --min-acquire-sites guards against a
+# refactor silently unhooking the model (a scan that sees nothing finds
+# nothing). JSON report lands in build/flowcheck.json for CI artifacts.
+flowcheck:
+	env JAX_PLATFORMS=cpu python -m nnstreamer_tpu flowcheck nnstreamer_tpu --min-acquire-sites 10 -o build/flowcheck.json
 
 # `make lint` = static gates: bytecode-compile the package, then run
 # pipelint over every pipeline description in tests/ and README.md

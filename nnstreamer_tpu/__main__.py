@@ -21,6 +21,11 @@ Concurrency analysis (racecheck)::
     python -m nnstreamer_tpu racecheck nnstreamer_tpu/
     python -m nnstreamer_tpu racecheck --json -o build/racecheck.json
 
+Settlement / conservation analysis (flowcheck)::
+
+    python -m nnstreamer_tpu flowcheck nnstreamer_tpu/
+    python -m nnstreamer_tpu flowcheck --json -o build/flowcheck.json
+
 Fleet telemetry (scrapes obs metrics endpoints into one table)::
 
     python -m nnstreamer_tpu top --targets localhost:9100,localhost:9101
@@ -106,6 +111,9 @@ def main(argv=None) -> int:
     if argv and argv[0] == "racecheck":
         from .analysis.concurrency.cli import main as racecheck_main
         return racecheck_main(argv[1:])
+    if argv and argv[0] == "flowcheck":
+        from .analysis.flow.cli import main as flowcheck_main
+        return flowcheck_main(argv[1:])
     if argv and argv[0] == "top":
         from .obs.top import main as top_main
         return top_main(argv[1:])

@@ -1,0 +1,36 @@
+"""flowcheck — static settlement & resource-conservation analysis.
+
+pipelint validates pipeline GRAPHS, racecheck validates the lock
+discipline of the CODE; flowcheck proves the third property family:
+*conservation along every code path*. Every acquired resource token
+(window slot, KV block, accepted socket, admitted request) must settle
+exactly once — or its ownership must provably escape — on every path,
+including exception edges; every lossy settle must declare its loss in
+a counter; and every module's declared conservation identity must be
+both statically producible and arithmetically true at runtime.
+
+    from nnstreamer_tpu.analysis.flow import analyze_paths
+    report = analyze_paths(["nnstreamer_tpu/"])
+    assert report.exit_code == 0, report.to_text()
+
+See Documentation/accounting.md for the conservation model, the
+declared identities, ``@flow.acquires/@flow.settles`` annotation, the
+``# flow: owns(resource)`` handoff marker, and the
+``# flowcheck: ok(reason)`` suppression pragma.
+"""
+from .findings import (DOUBLE_SETTLE, IDENTITY_BREAK, LEAK,
+                       MISSING_DECLARED_LOSS, VACUOUS_COVERAGE,
+                       FlowFinding, FlowReport)
+from .model import FlowModel, scan_paths
+from .passes import analyze_paths, run_passes
+from .registry import (DECLARED_IDENTITIES, Identity, IdentityTerm,
+                       ResourceSpec, SPECS)
+from .runtime import IdentityResult, check_identities
+
+__all__ = [
+    "analyze_paths", "run_passes", "scan_paths", "FlowModel",
+    "FlowFinding", "FlowReport", "LEAK", "DOUBLE_SETTLE",
+    "MISSING_DECLARED_LOSS", "IDENTITY_BREAK", "VACUOUS_COVERAGE",
+    "ResourceSpec", "SPECS", "Identity", "IdentityTerm",
+    "DECLARED_IDENTITIES", "check_identities", "IdentityResult",
+]

@@ -446,6 +446,33 @@ class UnboundedAdmissionRule(Rule):
                 e.name, severity=Severity.INFO)
 
 
+class ShedNoRetryAfterRule(Rule):
+    """A SHED reply without a positive retry-after hint gives clients
+    nothing to pace themselves by: they hot-loop resubmitting into the
+    very overload that shed them, or back off blind. Every element that
+    mints SHEDs must carry a usable hint — backpressure is part of the
+    settlement contract (RESULT xor SHED-with-retry-after)."""
+
+    id = "shed-no-retry-after"
+    severity = Severity.WARNING
+
+    def check(self, ctx: LintContext):
+        for e in ctx.of_kind("tensor_serve_src", "tensor_serve_router"):
+            if float(getattr(e, "retry_after_ms", 0.0)) <= 0:
+                yield self.finding(
+                    f"retry-after-ms={float(e.retry_after_ms):g} on a "
+                    "shedding entry point: SHED replies carry no "
+                    "backpressure hint, so shed clients resubmit "
+                    "immediately into the same overload", e.name)
+        for e in ctx.of_kind("tensor_filter"):
+            if int(getattr(e, "breaker_threshold", 0)) > 0 and \
+                    float(getattr(e, "breaker_retry_after_ms", 0.0)) <= 0:
+                yield self.finding(
+                    "breaker-retry-after-ms<=0 with the circuit breaker "
+                    "armed: breaker-open sheds pace nothing upstream",
+                    e.name)
+
+
 class LinkResilienceRule(Rule):
     """Network-edge elements with no timeout or with reconnection
     disabled turn a transient peer outage into a permanent hang or a
@@ -992,7 +1019,8 @@ ALL_RULES: List[Rule] = [
     DanglingPadRule(), CycleRule(), TeeNoQueueRule(), JitSignatureRule(),
     ShardingRule(), ServeMeshRule(), MeshColocationRule(),
     SinklessBranchRule(), CombinerDtypeRule(),
-    UnboundedAdmissionRule(), LinkResilienceRule(), ErrorPolicyRule(),
+    UnboundedAdmissionRule(), ShedNoRetryAfterRule(),
+    LinkResilienceRule(), ErrorPolicyRule(),
     WireConfigRule(), FusionBreakRule(), FusionTransferRule(),
     SessionReplayBudgetRule(), SessionNoReconnectRule(),
     RouterNoReplicasRule(), RouterAffinitySessionlessRule(),

@@ -77,7 +77,13 @@ class TcpListener:
             except OSError:
                 return
             from .wire import tune_socket
-            tune_socket(conn)
+            try:
+                tune_socket(conn)
+            except OSError:
+                # peer died between accept and setsockopt: close the
+                # fd instead of leaking it
+                conn.close()
+                continue
             if self._spawn:
                 threading.Thread(target=self._on_conn, args=(conn,),
                                  name=f"{self._name}-conn",

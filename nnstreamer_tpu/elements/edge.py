@@ -79,6 +79,10 @@ class EdgeSink(SinkElement):
              # (evictions become *declared* loss, never silent)
              "session": True, "session-ring-kb": 8192}
 
+    # conservation identity flowcheck proves statically and
+    # check_identities() asserts over live stats snapshots
+    SETTLEMENT_IDENTITY = ("session-delivery",)
+
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self._listener: Optional[socket.socket] = None

@@ -626,18 +626,26 @@ class TensorFilter(Element):
             handle = self.fw.dispatch(inputs,
                                       donate=bool(self.donate_input))
         except InvokeDrop:
+            # release FIRST: the accounting below must not be able to
+            # strand the slot (the completer never sees this frame)
+            self._overlap.window.release(t_disp)
             if self._breaker is not None:
                 self._breaker.record_success()
             self.stats.inc("frames_dropped")
-            self._overlap.window.release(t_disp)
             return
         except Exception as exc:  # noqa: BLE001
+            self._overlap.window.release(t_disp)
             self._account_invoke_error(exc)
             self._settle_failed_rows(buf)
-            self._overlap.window.release(t_disp)
             return
-        self._record_dispatch(time.perf_counter_ns() - t0)
-        self._overlap.submit(buf, handle, t_disp)
+        try:
+            self._record_dispatch(time.perf_counter_ns() - t0)
+            self._overlap.submit(buf, handle, t_disp)
+        except BaseException:
+            # a dispatch-side failure after acquire: the slot would
+            # otherwise leak window depth permanently
+            self._overlap.window.release(t_disp)
+            raise
 
     def _complete_frame(self, entry) -> Buffer:
         """COMPLETER side: materialize one frame's results and run the
@@ -678,6 +686,18 @@ class TensorFilter(Element):
                     logger.warning("%s: shed callback failed for "
                                    "stream %s", self.name,
                                    req.stream_id, exc_info=True)
+        self._record_shed_failed(buf, len(rows))
+
+    @staticmethod
+    def _record_shed_failed(buf: Buffer, n: int) -> None:
+        """Report rows settled by the filter's failure paths back to the
+        scheduler: they left its batcher as ``submitted`` but no demuxed
+        result ever returns, so without this terminal the serve
+        settlement identity (requests == completed + shed_deadline +
+        cancelled + shed_failed + pending) cannot balance."""
+        sched = buf.extras.get("serve_sched")
+        if sched is not None:
+            sched.record_shed_failed(n)
 
     def _account_invoke_error(self, exc: BaseException) -> None:
         # invoke failure drops THIS frame but keeps the pipeline alive
@@ -746,6 +766,7 @@ class TensorFilter(Element):
                         logger.warning("%s: shed callback failed for "
                                        "stream %s", self.name,
                                        req.stream_id, exc_info=True)
+            self._record_shed_failed(buf, len(rows))
         self.send_upstream_event(QosEvent(
             proportion=2.0, period_ns=int(retry_after_ms * 1e6),
             timestamp=buf.pts))

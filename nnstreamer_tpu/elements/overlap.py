@@ -261,48 +261,58 @@ class OverlapExecutor:
                     if self._stopping:
                         return
                     continue
-                entry = self._q.popleft()
+                entry = self._q.popleft()  # flow: owns(window-slot)
             # settle the frame OUTSIDE the lock: completion is a device
             # wait (racecheck: blocking call must not run under _cv)
-            outbuf: Any = None
-            err: Optional[BaseException] = None
-            t_wall = time.time_ns() if _obs_spans.ENABLED else 0
-            try:
-                outbuf = self._complete_cb(entry)
-            except BaseException as exc:  # noqa: BLE001 — accounted below
-                err = exc
-            if t_wall:
-                extras = getattr(entry.buf, "extras", None)
-                ctx = extras.get(_obs_ctx.CTX_KEY) if extras is not None \
-                    else None
-                if ctx is not None:
-                    dur = time.time_ns() - t_wall
-                    _obs_spans.record_span(f"{self._name}:complete",
-                                           "complete", t_wall, dur, ctx)
-                    ctx.c_ns += dur
-            if err is None:
-                ready = ([outbuf] if self._reorder is None
-                         else self._reorder.push(entry.seq, outbuf))
-            else:
-                try:
-                    self._error_cb(entry, err)
-                except Exception:  # noqa: BLE001 — never kill the loop
-                    log.exception("%s: error callback failed", self._name)
-                ready = ([] if self._reorder is None
-                         else self._reorder.skip(entry.seq))
-            if self._reorder is not None:
-                ready.extend(self._reorder.poll())
-            n_err = 1 if err is not None else 0
+            n_err = 0
             n_push_err = 0
-            for out in ready:
+            try:
+                outbuf: Any = None
+                err: Optional[BaseException] = None
+                t_wall = time.time_ns() if _obs_spans.ENABLED else 0
                 try:
-                    self._push_cb(out)
-                except Exception:  # noqa: BLE001 — downstream failure
-                    # must not wedge the window: count and keep going
-                    n_push_err += 1
-                    log.exception("%s: downstream push failed for a "
-                                  "completed frame", self._name)
-            self.window.release(entry.t_dispatch_ns)
+                    outbuf = self._complete_cb(entry)
+                except BaseException as exc:  # noqa: BLE001 — accounted
+                    err = exc
+                if t_wall:
+                    extras = getattr(entry.buf, "extras", None)
+                    ctx = extras.get(_obs_ctx.CTX_KEY) \
+                        if extras is not None else None
+                    if ctx is not None:
+                        dur = time.time_ns() - t_wall
+                        _obs_spans.record_span(f"{self._name}:complete",
+                                               "complete", t_wall, dur,
+                                               ctx)
+                        ctx.c_ns += dur
+                if err is None:
+                    ready = ([outbuf] if self._reorder is None
+                             else self._reorder.push(entry.seq, outbuf))
+                else:
+                    try:
+                        self._error_cb(entry, err)
+                    except Exception:  # noqa: BLE001 — never kill loop
+                        log.exception("%s: error callback failed",
+                                      self._name)
+                    ready = ([] if self._reorder is None
+                             else self._reorder.skip(entry.seq))
+                if self._reorder is not None:
+                    ready.extend(self._reorder.poll())
+                n_err = 1 if err is not None else 0
+                for out in ready:
+                    try:
+                        self._push_cb(out)
+                    except Exception:  # noqa: BLE001 — downstream
+                        # failure must not wedge the window: count and
+                        # keep going
+                        n_push_err += 1
+                        log.exception("%s: downstream push failed for a "
+                                      "completed frame", self._name)
+            finally:
+                # release in a finally: if the reorder buffer or an
+                # error callback raises, a skipped release would strand
+                # the slot and permanently shrink the window (the next
+                # submit restarts the thread, but the depth is gone)
+                self.window.release(entry.t_dispatch_ns)
             with self._cv:
                 self._completed += 1 - n_err
                 self._errors += n_err

@@ -225,7 +225,13 @@ class TensorQueryServerSrc(SrcElement):
                 conn, addr = self._listener.accept()
             except OSError:
                 return
-            wire.tune_socket(conn)
+            try:
+                wire.tune_socket(conn)
+            except OSError:
+                # peer died between accept and setsockopt: close the
+                # fd instead of leaking it
+                conn.close()
+                continue
             cid = self._next_client[0]
             self._next_client[0] += 1
             SERVER_TABLE.add_conn(self.id, cid, conn)

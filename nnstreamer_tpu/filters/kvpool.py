@@ -49,6 +49,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..utils import flowmarks as flow
 from ..utils.atomic import Counters
 
 _POOL_LOCK = threading.Lock()
@@ -108,6 +109,7 @@ class KVBlockPool:
 
     # -- allocation ----------------------------------------------------
 
+    @flow.acquires("kv-block")
     def alloc(self, n: int) -> Optional[List[int]]:
         """Take ``n`` fresh blocks (refcount 1 each), evicting LRU
         cache leaves as needed. None when the pool cannot satisfy the
@@ -126,6 +128,7 @@ class KVBlockPool:
                 self._ref[p] = 1
             return out
 
+    @flow.acquires("kv-block")
     def retain(self, phys: Sequence[int]) -> None:
         with self._lock:
             for p in phys:
@@ -133,6 +136,7 @@ class KVBlockPool:
                     raise ValueError(f"retain of free block {p}")
                 self._ref[p] += 1
 
+    @flow.settles("kv-block")
     def release(self, phys: Sequence[int]) -> None:
         """Drop one reference per block; blocks whose count reaches
         zero return to the free list (cache-committed blocks keep the
@@ -145,6 +149,7 @@ class KVBlockPool:
                 if self._ref[p] == 0:
                     self._free.append(p)
 
+    @flow.acquires("kv-block")
     def cow(self, phys: int) -> tuple:
         """Copy-on-write: -> (phys', needs_copy). A sole owner keeps
         its block; a shared block costs one fresh block (the caller
@@ -162,6 +167,7 @@ class KVBlockPool:
 
     # -- prefix cache --------------------------------------------------
 
+    @flow.acquires("kv-block")
     def lookup(self, hashes: Sequence[str]) -> List[int]:
         """Adopt the longest cached consecutive prefix of ``hashes``.
         Returned blocks are retained for the caller (release when the

@@ -216,25 +216,33 @@ class _PagedBackend:
             raise _PoolFull(f"need {need - len(cov)} blocks")
         allb = list(cov) + list(fresh)
         p0 = len(cov) * self.bs
-        if cov:
-            nbb = 1
-            while nbb < len(cov):
-                nbb *= 2
-            phys_pad = list(cov) + [cov[-1]] * (nbb - len(cov))
-            pk, pv = f._pool_gather(
-                self.pool, jnp.asarray(np.asarray(phys_pad, np.int32)))
-            l1, sk, sv = self._suffix_prefill(pk, pv, p0, prompt[p0:])
-            f.stats.add(prefill_dispatches=1, prefill_cached_tokens=p0,
-                        prefill_computed_tokens=plen - p0)
-            self._insert_span(fresh, np.asarray(sk), np.asarray(sv),
-                              plen - p0)
-        else:
-            l1, c1 = f._prefill_prompt(prompt, self.max_len)
-            self._insert_span(allb, np.asarray(c1["k"][:, 0]),
-                              np.asarray(c1["v"][:, 0]), plen)
-        if f._prefix_cache and hashes:
-            self.mgr.commit(hashes, allb[:len(hashes)])
-        self._seat(slot, allb, need, plen, l1)
+        try:
+            if cov:
+                nbb = 1
+                while nbb < len(cov):
+                    nbb *= 2
+                phys_pad = list(cov) + [cov[-1]] * (nbb - len(cov))
+                pk, pv = f._pool_gather(
+                    self.pool, jnp.asarray(np.asarray(phys_pad, np.int32)))
+                l1, sk, sv = self._suffix_prefill(pk, pv, p0, prompt[p0:])
+                f.stats.add(prefill_dispatches=1, prefill_cached_tokens=p0,
+                            prefill_computed_tokens=plen - p0)
+                self._insert_span(fresh, np.asarray(sk), np.asarray(sv),
+                                  plen - p0)
+            else:
+                l1, c1 = f._prefill_prompt(prompt, self.max_len)
+                self._insert_span(allb, np.asarray(c1["k"][:, 0]),
+                                  np.asarray(c1["v"][:, 0]), plen)
+            if f._prefix_cache and hashes:
+                self.mgr.commit(hashes, allb[:len(hashes)])
+            self._seat(slot, allb, need, plen, l1)
+        except BaseException:
+            # admission failed after taking refs: hand every block back.
+            # (If commit already ran, release only drops the stream
+            # refs — the cache's own refs legitimately keep the prefix
+            # blocks resident.)
+            self.mgr.release(allb)
+            raise
 
     def admit_handoff(self, slot: int, flat: np.ndarray, kv: Dict,
                       budget: int) -> None:
@@ -283,15 +291,22 @@ class _PagedBackend:
         fresh = self.mgr.alloc(need)
         if fresh is None:
             raise _PoolFull(f"need {need} blocks")
-        self._insert_span(fresh, full_k, full_v, plen)
-        if f._prefix_cache:
-            from .kvpool import chain_hashes
-            hashes = chain_hashes(np.asarray(kv["prompt"], np.int32),
-                                  self.bs)
-            usable = min(len(hashes), need)
-            if usable:
-                self.mgr.commit(hashes[:usable], fresh[:usable])
-        self._seat(slot, list(fresh), need, plen, l1)
+        try:
+            self._insert_span(fresh, full_k, full_v, plen)
+            if f._prefix_cache:
+                from .kvpool import chain_hashes
+                hashes = chain_hashes(np.asarray(kv["prompt"], np.int32),
+                                      self.bs)
+                usable = min(len(hashes), need)
+                if usable:
+                    self.mgr.commit(hashes[:usable], fresh[:usable])
+            self._seat(slot, list(fresh), need, plen, l1)
+        except BaseException:
+            # a failed handoff fold must not strand the receiver's
+            # blocks: the sender only counts kv_handoff_errors, so a
+            # leaked ref here would shrink the pool forever
+            self.mgr.release(list(fresh))
+            raise
 
     def _seat(self, slot: int, allb: List[int], need: int, plen: int,
               l1) -> None:

@@ -227,7 +227,13 @@ class FusedSegment(TransformElement):
             tracer.observe(f"fusion/{self.name}", dt)
         if self._overlap is not None:
             t_disp = self._overlap.window.acquire()
-            self._overlap.submit(buf, outs, t_disp)
+            try:
+                self._overlap.submit(buf, outs, t_disp)
+            except BaseException:
+                # never strand the slot on a failed enqueue: the
+                # completer will not see this frame
+                self._overlap.window.release(t_disp)
+                raise
             return
         if self._breaker is not None:
             self._breaker.record_success()

@@ -151,6 +151,28 @@ class BucketBatcher:
             self.stats.inc("cancelled", n)
         return n
 
+    def cancel_requests(self, reqs: Sequence[Request]) -> int:
+        """Remove specific still-queued requests (identity match — the
+        Request object IS the in-process correlation id). Used by the
+        ROI gate to reclaim a shed frame's sibling crops; each removal
+        counts as ``cancelled`` so the frame's settlement stays exact.
+        Requests already popped into a batch are not cancellable."""
+        with self._cond:
+            drop = {id(r) for r in reqs}
+            removed = [r for r in self._fifo if id(r) in drop]
+            if not removed:
+                return 0
+            self._fifo = deque(r for r in self._fifo
+                               if id(r) not in drop)
+            for r in removed:
+                n = self._per_stream.get(r.stream_id, 1) - 1
+                if n <= 0:
+                    self._per_stream.pop(r.stream_id, None)
+                else:
+                    self._per_stream[r.stream_id] = n
+            self.stats.inc("cancelled", len(removed))
+        return len(removed)
+
     def depth(self, stream_id: Any = None) -> int:
         with self._cond:
             if stream_id is None:

@@ -90,6 +90,10 @@ class TensorServeSrc(SrcElement):
     # the scheduler records queue_wait + batch spans on the request ctx
     SPAN_POINTS = ("queue-wait", "batch", "chain")
 
+    # conservation identities flowcheck proves statically and
+    # check_identities() asserts over live report() snapshots
+    SETTLEMENT_IDENTITY = ("serve-settlement", "roi-settlement")
+
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self._listener: Optional[socket.socket] = None
@@ -111,7 +115,7 @@ class TensorServeSrc(SrcElement):
         self._broker_sock: Optional[socket.socket] = None
         self.stats["link_errors"] = 0
         self.stats.update({"serve_roi_requests": 0, "serve_roi_crops": 0,
-                           "serve_roi_shed": 0})
+                           "serve_roi_shed": 0, "serve_roi_results": 0})
 
     @property
     def bound_port(self) -> int:
@@ -216,7 +220,13 @@ class TensorServeSrc(SrcElement):
                 conn, _addr = self._listener.accept()
             except OSError:
                 return
-            wire.tune_socket(conn)
+            try:
+                wire.tune_socket(conn)
+            except OSError:
+                # peer died between accept and setsockopt: close the
+                # fd instead of leaking it
+                conn.close()
+                continue
             cid = self._next_client[0]
             self._next_client[0] += 1
             with self._clock:
@@ -312,16 +322,31 @@ class TensorServeSrc(SrcElement):
         self.stats.add(serve_roi_requests=1, serve_roi_crops=n)
         agg = {"rows": [None] * n, "left": n, "settled": False,
                "lock": threading.Lock(), "roi": roi, "pts": buf.pts,
-               "seq": seq}
+               "seq": seq, "reqs": []}
         ctx = _obs_ctx.ctx_of(buf)
         for k in range(n):
-            self.scheduler.submit(
+            with agg["lock"]:
+                if agg["settled"]:
+                    # an earlier crop already shed the frame (admission
+                    # shed runs its callback inline): stop feeding the
+                    # batcher work whose results would be discarded
+                    break
+            req = self.scheduler.admit(
                 cid, [np.ascontiguousarray(crops[k])],
                 seq=seq, pts=buf.pts,
                 on_result=lambda req, row, k=k, agg=agg:
                     self._roi_part(cid, agg, k, row),
                 on_shed=lambda req, agg=agg: self._roi_shed(cid, agg),
                 ctx=ctx)
+            if req is not None:
+                with agg["lock"]:
+                    agg["reqs"].append(req)
+        # the shed may have landed between the final admit and here:
+        # reclaim whatever siblings are still queued (idempotent)
+        with agg["lock"]:
+            siblings = list(agg["reqs"]) if agg["settled"] else []
+        if siblings:
+            self.scheduler.cancel_requests(siblings)
 
     def _roi_part(self, cid: int, agg: dict, k: int, row) -> None:
         with agg["lock"]:
@@ -332,6 +357,9 @@ class TensorServeSrc(SrcElement):
             if agg["left"] > 0:
                 return
             agg["settled"] = True
+        # frame-level terminal: exactly one RESULT per ROI request
+        # (roi-settlement identity: requests == results + shed + pending)
+        self.stats.inc("serve_roi_results")
         rows = agg["rows"]
         stacked = [np.stack([r[j] for r in rows])
                    for j in range(len(rows[0]))]
@@ -347,11 +375,16 @@ class TensorServeSrc(SrcElement):
 
     def _roi_shed(self, cid: int, agg: dict) -> None:
         """Any shed crop sheds the whole frame: a partial stitch would
-        silently mix epochs. Exactly one SHED answers the request."""
+        silently mix epochs. Exactly one SHED answers the request, and
+        the frame's still-queued sibling crops are cancelled — leaving
+        them would burn TPU batches on rows whose frame already died."""
         with agg["lock"]:
             if agg["settled"]:
                 return
             agg["settled"] = True
+            siblings = list(agg["reqs"])
+        if siblings:
+            self.scheduler.cancel_requests(siblings)
         self.stats.inc("serve_roi_shed")
         self._send(cid, MsgKind.SHED,
                    {"pts": agg["pts"], "seq": agg["seq"], "client_id": cid,
@@ -482,6 +515,11 @@ class TensorServeSrc(SrcElement):
         out = Buffer([Chunk(x) for x in stacked], pts=batch[0].pts)
         out.extras["serve_rows"] = batch
         out.extras["serve_id"] = self.id
+        # the filter's failure paths (invoke error, breaker-open shed)
+        # settle rows via on_shed but the scheduler never sees a demuxed
+        # result for them — this handle lets the filter report them as
+        # shed_failed so the settlement identity stays balanced
+        out.extras["serve_sched"] = self.scheduler
         # the filter slices padded HOST rows off before any D2H
         out.extras["batch_valid_rows"] = len(batch)
         if batch[0].ctx is not None:

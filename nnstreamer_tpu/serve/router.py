@@ -889,6 +889,10 @@ class TensorServeRouter(Element):
              # failover budget per request before it sheds
              "max-redispatch": 3}
 
+    # conservation identity flowcheck proves statically and
+    # check_identities() asserts over live stats snapshots
+    SETTLEMENT_IDENTITY = ("router-settlement",)
+
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self.router: Optional[FleetRouter] = None

@@ -82,11 +82,34 @@ class ServeScheduler:
         self._queue_delay = Reservoir()
         self._batch_latency = Reservoir()
         self.stats = Counters(completed=0, rows_padded=0, bucket_rows=0,
-                              result_errors=0, invoke_errors=0)
+                              result_errors=0, invoke_errors=0,
+                              shed_failed=0)
         # ledger recovered from a preemption snapshot (read under _mlock)
         self.recovered_ledger: List[Dict[str, Any]] = []
 
     # -- producers ---------------------------------------------------------
+    def admit(self, stream_id: Any, arrays: Sequence[Any], *,
+              seq: Optional[int] = None, pts: Optional[int] = None,
+              on_result: Optional[Callable] = None,
+              on_shed: Optional[Callable] = None,
+              deadline_s: Optional[float] = None,
+              ctx: Optional[Any] = None) -> Optional[Request]:
+        """Admit one request and return its handle (None = shed at
+        admission; ``on_shed`` has already been invoked). The handle is
+        what :meth:`cancel_requests` cancels — callers that may shed a
+        composite (e.g. every sibling crop of an ROI frame) keep it."""
+        dl = self.deadline_s if deadline_s is None else deadline_s
+        req = Request(stream_id, arrays, seq=seq, pts=pts,
+                      deadline=(time.monotonic() + dl) if dl > 0 else None,
+                      on_result=on_result, on_shed=on_shed, ctx=ctx)
+        if self.batcher.submit(req):
+            return req
+        _obs_events.emit("shed", source=self.name, reason="admission",
+                         stream=str(stream_id))
+        if on_shed is not None:
+            on_shed(req)
+        return None
+
     def submit(self, stream_id: Any, arrays: Sequence[Any], *,
                seq: Optional[int] = None, pts: Optional[int] = None,
                on_result: Optional[Callable] = None,
@@ -96,20 +119,30 @@ class ServeScheduler:
         """Admit one request. False = shed at admission; the ``on_shed``
         callback has already been invoked (retry-after is the caller's
         wire-level answer)."""
-        dl = self.deadline_s if deadline_s is None else deadline_s
-        req = Request(stream_id, arrays, seq=seq, pts=pts,
-                      deadline=(time.monotonic() + dl) if dl > 0 else None,
-                      on_result=on_result, on_shed=on_shed, ctx=ctx)
-        if self.batcher.submit(req):
-            return True
-        _obs_events.emit("shed", source=self.name, reason="admission",
-                         stream=str(stream_id))
-        if on_shed is not None:
-            on_shed(req)
-        return False
+        return self.admit(stream_id, arrays, seq=seq, pts=pts,
+                          on_result=on_result, on_shed=on_shed,
+                          deadline_s=deadline_s, ctx=ctx) is not None
 
     def cancel_stream(self, stream_id: Any) -> int:
         return self.batcher.cancel_stream(stream_id)
+
+    def cancel_requests(self, reqs: Sequence[Request]) -> int:
+        """Cancel specific still-queued requests (ROI sibling-crop
+        cleanup on a shed frame). Returns how many were removed; each
+        counts as ``cancelled`` in the settlement identity. Requests
+        already batched are past cancellation and settle normally."""
+        return self.batcher.cancel_requests(reqs)
+
+    def record_shed_failed(self, n: int = 1) -> None:
+        """Terminal accounting for batched-but-failed rows: an invoke
+        failure sheds the whole batch via per-request ``on_shed``, and
+        this counter is what keeps ``requests == completed +
+        shed_deadline + cancelled + shed_failed + pending`` balanced.
+        The pipeline embedding (tensor_filter) calls this from its
+        invoke-failure and breaker-open paths."""
+        if n > 0:
+            with self._mlock:
+                self.stats.inc("shed_failed", n)
 
     def drain(self) -> None:
         """Graceful teardown: close admission (late submits shed with
@@ -283,6 +316,7 @@ class ServeScheduler:
             "shed_admission": b["shed_admission"],
             "shed_deadline": b["shed_deadline"],
             "cancelled": b["cancelled"],
+            "shed_failed": s["shed_failed"],
             "result_errors": s["result_errors"],
             "invoke_errors": s["invoke_errors"],
             "occupancy_avg": (filled / s["bucket_rows"]
@@ -322,6 +356,10 @@ class ServeScheduler:
             except Exception as exc:  # noqa: BLE001 — shed the batch, keep serving
                 with self._mlock:
                     self.stats.inc("invoke_errors")
+                    # the batch's rows left the queue but will never
+                    # complete(): count their terminal event so the
+                    # settlement identity balances
+                    self.stats.inc("shed_failed", len(batch))
                 logger.warning("%s: invoke failed (%r), batch of %d shed",
                                self.name, exc, len(batch), exc_info=True)
                 _obs_events.emit("shed", source=self.name, reason="invoke",

@@ -43,6 +43,8 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..utils import flowmarks as flow
+
 # cap on arrays per RPC so one giant drain can't add unbounded latency
 # to the frames queued behind it
 _MAX_ARRAYS_PER_RPC = 256
@@ -406,6 +408,7 @@ class InFlightWindow:
         self._first_ns: Optional[int] = None
         self._last_ns: Optional[int] = None
 
+    @flow.acquires("window-slot")
     def acquire(self, timeout: Optional[float] = None) -> Optional[int]:
         """Take a window slot; returns the dispatch timestamp (ns) to
         hand back to :meth:`release`, or None on timeout."""
@@ -426,6 +429,7 @@ class InFlightWindow:
                 self._first_ns = now
             return now
 
+    @flow.settles("window-slot")
     def release(self, t_dispatch_ns: int) -> None:
         import time as _time
         now = _time.perf_counter_ns()

@@ -232,6 +232,33 @@ class TestRules:
         report = analyze(parse_launch(desc))
         assert report.exit_code == 0  # info never fails the gate
 
+    def test_shed_no_retry_after(self):
+        bad = (  # pipelint: skip — retry-after-ms=0 sheds with no hint
+            "tensor_serve_src name=s retry-after-ms=0 ! "
+            "tensor_filter framework=jax model=zoo://mlp ! "
+            "tensor_serve_sink")
+        got = findings_for(bad, "shed-no-retry-after")
+        assert [(f.element, f.severity) for f in got] == \
+            [("s", Severity.WARNING)]
+        assert "retry-after-ms=0" in got[0].message
+
+    def test_breaker_armed_without_retry_after(self):
+        bad = (  # pipelint: skip — armed breaker, no shed pacing hint
+            "tensor_serve_src name=s ! "
+            "tensor_filter name=f framework=jax model=zoo://mlp "
+            "breaker-threshold=3 breaker-retry-after-ms=0 ! "
+            "tensor_serve_sink")
+        got = findings_for(bad, "shed-no-retry-after")
+        assert [(f.element, f.severity) for f in got] == \
+            [("f", Severity.WARNING)]
+        assert "breaker" in got[0].message
+
+    def test_positive_retry_after_is_clean(self):
+        desc = ("tensor_serve_src name=s retry-after-ms=25 ! "
+                "tensor_filter framework=jax model=zoo://mlp "
+                "breaker-threshold=3 ! tensor_serve_sink")
+        assert findings_for(desc, "shed-no-retry-after") == []
+
     def test_link_resilience_no_timeout(self):
         bad = (  # pipelint: skip — timeout=0 hangs on a dead peer
             f"tensortestsrc caps={CAPS_U8} ! "

@@ -169,6 +169,35 @@ class TestOverlapExecutor:
         ex.stop()
         assert ex.report()["push_errors"] == 4
 
+    def test_settle_crash_still_releases_the_slot(self):
+        """A crash between completion and release (a reorder-buffer bug,
+        an exploding error callback) must not strand the slot: release
+        sits in a finally, so the window keeps its depth even when the
+        completer thread dies mid-settle (found by `make flowcheck`)."""
+        ex, _ = self._make(limit=1)
+
+        class _BoomReorder:
+            def __len__(self):
+                return 0
+
+            def push(self, seq, item, now=None):
+                raise RuntimeError("reorder boom")
+
+            def skip(self, seq, now=None):
+                return []
+
+            def poll(self, now=None):
+                return []
+
+        ex._reorder = _BoomReorder()
+        ex.submit(_Item(0), None, ex.window.acquire())
+        # limit=1: if the crashed settle leaked its slot this blocks
+        # forever instead of going idle
+        assert ex.window.wait_idle(10.0), \
+            "settle crash leaked the window slot"
+        assert ex.window.report()["in_flight"] == 0
+        ex.stop()
+
 
 # ------------------------------------------------------ pipeline (simlink)
 

@@ -17,6 +17,7 @@ import numpy as np
 import pytest
 
 from nnstreamer_tpu import Buffer, parse_launch
+from nnstreamer_tpu.analysis.flow import check_identities
 from nnstreamer_tpu.fault import (CLOSED, HALF_OPEN, OPEN, Backoff,
                                   CircuitBreaker, ErrorPolicy, FaultInjected,
                                   RestartBudget, TransientError, is_transient,
@@ -770,6 +771,11 @@ class TestZeroLossChaos:
         assert ss["session_delivered"] == n
         assert ss["session_declared_lost"] == 0
         assert ps["session_declared_lost"] == 0
+        # the declared conservation identity over the merged two-end
+        # snapshot: what the publisher stamped equals delivered + the
+        # declared losses, exactly, across every kill/replay
+        check_identities({**ss, "session_sent": ps["session_sent"]},
+                         names=["session-delivery"])
         assert ps["session_resumes"] == kills
         assert ss["reconnects"] == kills
         assert ps["session_replayed"] >= ss["session_dup_drops"]
@@ -873,6 +879,10 @@ class TestZeroLossChaos:
         assert ps["session_declared_lost"] == lost
         assert ss["session_delivered"] == total - lost
         assert delivered2 == total - lost
+        # even with a real eviction gap the identity balances exactly:
+        # the loss is declared, never silent
+        check_identities({**ss, "session_sent": ps["session_sent"]},
+                         names=["session-delivery"])
         # the bus carries the declaration with the exact count
         assert msgs and msgs[0].data["frames_lost"] == lost
         # and the oldest frames are the evicted ones: the survivors are

@@ -323,6 +323,11 @@ class TestDeltaPipelines:
             det_stats["delta_tiles_total"] - det_stats["delta_tiles_skipped"]
         assert srv_stats["serve_roi_crops"] < 4 * 4
         assert srv_stats["serve_roi_shed"] == 0
+        # whole-frame settlement: every ROI request reached exactly one
+        # RESULT (the roi-settlement conservation identity)
+        from nnstreamer_tpu.analysis.flow import check_identities
+        check_identities({**srv_stats, "serve_roi_pending": 0},
+                         names=["roi-settlement"])
         # every inferred row was a crop, never a full frame — and the
         # batcher stacked exactly the admitted crops, no more
         roi_rows = sum(s[0] for s in crops_seen if s[-3:] == (8, 8, 3))

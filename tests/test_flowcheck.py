@@ -1,0 +1,418 @@
+"""flowcheck: static settlement / conservation analyzer over
+un-executed sources.
+
+Seeds one fixture module per defect class and asserts the analyzer
+reports the right rule at the right ``file:line`` — without importing,
+let alone running, the fixture code. Mirrors test_racecheck.py: defect
+corpus + clean corpus + pragma scoping + CLI exit-code contract
+(0 clean / 1 findings / 2 usage error).
+"""
+import json
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from nnstreamer_tpu.analysis.flow import (DOUBLE_SETTLE, IDENTITY_BREAK,
+                                          LEAK, MISSING_DECLARED_LOSS,
+                                          VACUOUS_COVERAGE, analyze_paths,
+                                          check_identities)
+from nnstreamer_tpu.analysis.flow.cli import main as flowcheck_main
+
+PACKAGE_DIR = Path(__file__).resolve().parents[1] / "nnstreamer_tpu"
+
+
+def check(tmp_path, source, name="fixture.py", rule=None):
+    """Write one fixture module, scan it, return (findings, report)."""
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(source))
+    report = analyze_paths([str(f)])
+    if rule is None:
+        return report.findings, report
+    return report.by_rule(rule), report
+
+
+# --------------------------------------------------------------- fixtures
+# Module-level constants carry NO base indentation so line numbers in the
+# written file match the literal, and targeted str.replace stays honest.
+
+LEAK_EXCEPT = """\
+class Filter:
+    def dispatch(self, buf):
+        t = self.window.acquire()
+        self.submit(buf, t)
+        self.window.release(t)
+"""
+LEAK_EXCEPT_LINE = 4        # the call whose raise path strands the slot
+LEAK_EXCEPT_ACQUIRE = 3
+
+LEAK_RETURN = """\
+class Filter:
+    def dispatch(self, buf):
+        t = self.window.acquire()
+        if buf is None:
+            return
+        self.window.release(t)
+"""
+LEAK_RETURN_LINE = 3        # pinned at the acquire that can't settle
+
+DOUBLE = """\
+class Filter:
+    def dispatch(self):
+        t = self.window.acquire()
+        self.window.release(t)
+        self.window.release(t)
+"""
+DOUBLE_LINE = 5
+
+LOSS = """\
+class Ring:
+    def trim(self):
+        self._ring.evict(3)
+"""
+LOSS_LINE = 3
+
+IDENTITY = """\
+FLOW_IDENTITY = "requests == done + shed"
+
+
+class Counterized:
+    def work(self):
+        self.stats.inc("requests")
+        self.stats.inc("done")
+"""
+IDENTITY_LINE = 1           # pinned at the FLOW_IDENTITY declaration
+
+CUSTOM = """\
+from nnstreamer_tpu.utils import flowmarks as flow
+
+
+class LeasePool:
+    @flow.acquires("lease")
+    def take(self):
+        pass
+
+    @flow.settles("lease")
+    def give(self, x):
+        pass
+
+
+class BadUser:
+    def use(self):
+        x = self.leases.take()
+"""
+CUSTOM_LINE = 16
+
+CLEAN = """\
+class Filter:
+    def dispatch(self, buf):
+        t = self.window.acquire()
+        try:
+            self.submit(buf, t)
+        finally:
+            self.window.release(t)
+"""
+
+
+# ------------------------------------------------------------- leak pass
+
+class TestLeakPass:
+    def test_leak_on_exception_path_located(self, tmp_path):
+        """A call between acquire and settle that can raise strands the
+        slot — the finding pins the RAISING call, names the acquire."""
+        got, _ = check(tmp_path, LEAK_EXCEPT, rule=LEAK)
+        assert len(got) == 1
+        f = got[0]
+        assert f.line == LEAK_EXCEPT_LINE
+        assert f.resource == "window-slot"
+        assert "raises" in f.message
+        assert f"line {LEAK_EXCEPT_ACQUIRE}" in f.message
+        assert f.location.endswith(f"fixture.py:{LEAK_EXCEPT_LINE}")
+
+    def test_leak_on_early_return_located(self, tmp_path):
+        got, _ = check(tmp_path, LEAK_RETURN, rule=LEAK)
+        assert len(got) == 1
+        assert got[0].line == LEAK_RETURN_LINE
+        assert got[0].func == "Filter.dispatch"
+
+    def test_try_finally_release_is_clean(self, tmp_path):
+        got, _ = check(tmp_path, CLEAN)
+        assert got == []
+
+    def test_release_in_except_reraise_is_clean(self, tmp_path):
+        # the give-back-on-error idiom the shipped fixes use
+        got, _ = check(tmp_path, """\
+            class Filter:
+                def dispatch(self, buf):
+                    t = self.window.acquire()
+                    try:
+                        self.submit(buf, t)
+                    except BaseException:
+                        self.window.release(t)
+                        raise
+            """)
+        assert got == []
+
+    def test_escape_to_store_is_a_handoff(self, tmp_path):
+        # seating the token in an attribute transfers ownership: the
+        # holder (a completer, a lane table) settles it later
+        got, _ = check(tmp_path, """\
+            class Filter:
+                def dispatch(self, buf):
+                    t = self.window.acquire()
+                    self._pending[buf] = t
+            """)
+        assert got == []
+
+    def test_alias_release_settles_all_parts(self, tmp_path):
+        # release(allb) where allb = cov + fresh settles BOTH tokens
+        got, _ = check(tmp_path, """\
+            class Lanes:
+                def admit(self, cov_hashes, need):
+                    cov = self.mgr.lookup(cov_hashes)
+                    fresh = self.mgr.alloc(need)
+                    allb = cov + fresh
+                    try:
+                        self.seat(allb)
+                    except BaseException:
+                        self.mgr.release(allb)
+                        raise
+            """)
+        assert got == []
+
+
+# ----------------------------------------------------------- settle pass
+
+class TestSettlePass:
+    def test_double_settle_located(self, tmp_path):
+        got, _ = check(tmp_path, DOUBLE, rule=DOUBLE_SETTLE)
+        assert len(got) == 1
+        assert got[0].line == DOUBLE_LINE
+        assert "already settled" in got[0].message
+
+    def test_branch_exclusive_settles_are_clean(self, tmp_path):
+        # one settle per path is the contract; two paths, one each
+        got, _ = check(tmp_path, """\
+            class Filter:
+                def dispatch(self, ok):
+                    t = self.window.acquire()
+                    if ok:
+                        self.window.release(t)
+                    else:
+                        self.window.release(t)
+            """)
+        assert got == []
+
+
+# ------------------------------------------------------------- loss pass
+
+class TestLossPass:
+    def test_silent_loss_located(self, tmp_path):
+        got, _ = check(tmp_path, LOSS, rule=MISSING_DECLARED_LOSS)
+        assert len(got) == 1
+        assert got[0].line == LOSS_LINE
+        assert "loss counter" in got[0].message
+
+    def test_declared_loss_is_clean(self, tmp_path):
+        got, _ = check(tmp_path, """\
+            class Ring:
+                def trim(self):
+                    self._ring.evict(3)
+                    self.stats.inc("dropped")
+            """)
+        assert got == []
+
+    def test_counter_bumped_before_loss_is_clean(self, tmp_path):
+        got, _ = check(tmp_path, """\
+            class Ring:
+                def trim(self):
+                    self.stats.inc("declared_lost")
+                    self._ring.evict(3)
+            """)
+        assert got == []
+
+
+# --------------------------------------------------------- identity pass
+
+class TestIdentityPass:
+    def test_unproducible_identity_located(self, tmp_path):
+        got, _ = check(tmp_path, IDENTITY, rule=IDENTITY_BREAK)
+        assert len(got) == 1
+        assert got[0].line == IDENTITY_LINE
+        assert "'shed'" in got[0].message
+        assert "never produced" in got[0].message
+
+    def test_fully_produced_identity_is_clean(self, tmp_path):
+        src = IDENTITY + '        self.stats.inc("shed")\n'
+        got, _ = check(tmp_path, src)
+        assert got == []
+
+    def test_runtime_validator_passes_on_balanced_snapshot(self):
+        results = check_identities(
+            {"requests": 10, "completed": 6, "shed_deadline": 2,
+             "cancelled": 1, "shed_failed": 1, "pending": 0},
+            names=["serve-settlement"])
+        assert len(results) == 1 and results[0].holds
+
+    def test_runtime_validator_raises_on_imbalance(self):
+        with pytest.raises(AssertionError, match="serve-settlement"):
+            check_identities(
+                {"requests": 10, "completed": 6, "shed_deadline": 2,
+                 "cancelled": 0, "shed_failed": 0, "pending": 0},
+                names=["serve-settlement"])
+
+    def test_runtime_validator_rejects_unknown_identity(self):
+        with pytest.raises(KeyError):
+            check_identities({"x": 0}, names=["no-such-identity"])
+
+
+# --------------------------------------------------------- flow decorators
+
+class TestDecorators:
+    def test_decorated_resource_leak_detected(self, tmp_path):
+        """@flow.acquires/@flow.settles registers a NEW resource; a
+        caller that takes without giving leaks it."""
+        got, report = check(tmp_path, CUSTOM, rule=LEAK)
+        assert len(got) == 1
+        assert got[0].line == CUSTOM_LINE
+        assert got[0].resource == "lease"
+        assert report.acquire_sites >= 1
+
+    def test_decorated_resource_balanced_is_clean(self, tmp_path):
+        src = CUSTOM + "        self.leases.give(x)\n"
+        got, _ = check(tmp_path, src)
+        assert got == []
+
+
+# ----------------------------------------------------------------- pragma
+
+class TestPragma:
+    def test_pragma_suppresses_with_reason(self, tmp_path):
+        src = LEAK_RETURN.replace(
+            "t = self.window.acquire()",
+            "t = self.window.acquire()"
+            "  # flowcheck: ok(slot owned by harness)")
+        got, report = check(tmp_path, src)
+        assert got == []
+        assert len(report.suppressed) == 1
+        assert report.exit_code == 0
+
+    def test_pragma_on_line_above(self, tmp_path):
+        src = LEAK_RETURN.replace(
+            "        t = self.window.acquire()",
+            "        # flowcheck: ok(harness)\n"
+            "        t = self.window.acquire()")
+        got, report = check(tmp_path, src)
+        assert got == []
+        assert len(report.suppressed) == 1
+
+    def test_pragma_elsewhere_does_not_blanket(self, tmp_path):
+        src = "# flowcheck: ok(not here)\n" + LEAK_RETURN
+        got, report = check(tmp_path, src)
+        assert report.by_rule(LEAK)
+
+
+# -------------------------------------------------- corpus + distinctness
+
+class TestCorpus:
+    def test_four_distinct_finding_classes(self, tmp_path):
+        """The seeded corpus yields all four rule classes, each pinned
+        to its own file:line."""
+        for name, src in [("leak.py", LEAK_EXCEPT),
+                          ("double.py", DOUBLE),
+                          ("loss.py", LOSS),
+                          ("identity.py", IDENTITY),
+                          ("clean.py", CLEAN)]:
+            (tmp_path / name).write_text(src)
+        report = analyze_paths([str(tmp_path)])
+        rules = {f.rule for f in report.findings}
+        assert rules == {LEAK, DOUBLE_SETTLE, MISSING_DECLARED_LOSS,
+                         IDENTITY_BREAK}
+        files = {Path(f.file).name for f in report.findings}
+        assert "clean.py" not in files
+        for f in report.findings:
+            assert f.line > 0 and f.file
+
+    def test_self_scan_is_clean(self):
+        """The gate this PR ships: every acquire in the package settles
+        on every path, every declared loss is counted, every identity
+        is producible (deliberate exceptions are pragma'd with
+        reasons)."""
+        report = analyze_paths([str(PACKAGE_DIR)])
+        assert report.findings == [], report.to_text()
+        assert report.exit_code == 0
+
+    def test_self_scan_coverage_is_not_vacuous(self):
+        """A refactor that silently unhooks the model (renamed
+        receivers, dropped decorations) must trip the floor, not pass
+        by scanning nothing."""
+        report = analyze_paths([str(PACKAGE_DIR)])
+        assert report.acquire_sites >= 10, report.to_text()
+        assert len(report.identities_checked) >= 4
+        assert "serve-settlement" in report.identities_checked
+
+    def test_vacuous_coverage_guard_fires(self, tmp_path):
+        f = tmp_path / "clean.py"
+        f.write_text(CLEAN)
+        report = analyze_paths([str(f)], min_acquire_sites=10_000)
+        got = report.by_rule(VACUOUS_COVERAGE)
+        assert len(got) == 1
+        assert "10000" in got[0].message
+
+
+# -------------------------------------------------------------------- CLI
+
+class TestCli:
+    def test_exit_zero_on_clean(self, tmp_path, capsys):
+        f = tmp_path / "clean.py"
+        f.write_text(CLEAN)
+        assert flowcheck_main([str(f)]) == 0
+        assert "0 finding(s)" in capsys.readouterr().out
+
+    def test_exit_one_on_findings(self, tmp_path, capsys):
+        f = tmp_path / "double.py"
+        f.write_text(DOUBLE)
+        assert flowcheck_main([str(f)]) == 1
+        out = capsys.readouterr().out
+        assert "double-settle" in out
+        assert f"double.py:{DOUBLE_LINE}" in out
+
+    def test_exit_two_on_missing_path(self, tmp_path, capsys):
+        assert flowcheck_main([str(tmp_path / "nope")]) == 2
+
+    def test_exit_two_on_bad_flag(self, capsys):
+        assert flowcheck_main(["--no-such-flag"]) == 2
+
+    def test_json_round_trip(self, tmp_path, capsys):
+        f = tmp_path / "double.py"
+        f.write_text(DOUBLE)
+        assert flowcheck_main([str(f), "--json"]) == 1
+        data = json.loads(capsys.readouterr().out)
+        assert data["exit_code"] == 1
+        assert data["findings"][0]["rule"] == DOUBLE_SETTLE
+        assert data["findings"][0]["line"] == DOUBLE_LINE
+        assert data["acquire_sites"] == 1
+
+    def test_output_file_written(self, tmp_path, capsys):
+        f = tmp_path / "clean.py"
+        f.write_text(CLEAN)
+        out = tmp_path / "build" / "flowcheck.json"
+        assert flowcheck_main([str(f), "-o", str(out), "-q"]) == 0
+        data = json.loads(out.read_text())
+        assert data["exit_code"] == 0
+        assert capsys.readouterr().out == ""  # -q: exit code only
+
+    def test_verbose_lists_suppressed(self, tmp_path, capsys):
+        src = LEAK_RETURN.replace(
+            "t = self.window.acquire()",
+            "t = self.window.acquire()  # flowcheck: ok(harness)")
+        f = tmp_path / "leak.py"
+        f.write_text(src)
+        assert flowcheck_main([str(f), "-v"]) == 0
+        assert "suppressed" in capsys.readouterr().out
+
+    def test_min_acquire_sites_flag(self, tmp_path, capsys):
+        f = tmp_path / "clean.py"
+        f.write_text(CLEAN)
+        assert flowcheck_main([str(f), "--min-acquire-sites", "50"]) == 1
+        assert "vacuous-coverage" in capsys.readouterr().out

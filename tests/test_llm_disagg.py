@@ -376,6 +376,83 @@ class TestPagedFilterParity:
             f.close()
 
 
+class TestAdmissionLeakRegression:
+    """A failed admission must hand back every block it took. Leaked
+    refs never return to the free list, so each failure would shrink
+    the pool until nothing admits (found by `make flowcheck`)."""
+
+    BASE = "max_tokens:4,n_parallel:2,max_len:32,paged:true,block_size:8"
+
+    def _backend(self, f):
+        from nnstreamer_tpu.filters.llm import _PagedBackend
+        return _PagedBackend(f, 2, 32)
+
+    def test_admit_failure_releases_all_blocks(self):
+        f = mk_filter(self.BASE)
+        try:
+            be = self._backend(f)
+            used0 = f._pool_mgr.stats_dict()["blocks_used"]
+
+            def boom(*a, **k):
+                raise RuntimeError("insert failed")
+
+            be._insert_span = boom
+            with pytest.raises(RuntimeError, match="insert failed"):
+                be.admit(0, np.arange(1, 6, dtype=np.int32), 4)
+            assert f._pool_mgr.stats_dict()["blocks_used"] == used0, \
+                "failed admit leaked block refs"
+        finally:
+            f.close()
+
+    def test_handoff_failure_releases_all_blocks(self):
+        f = mk_filter(self.BASE)
+        try:
+            be = self._backend(f)
+            used0 = f._pool_mgr.stats_dict()["blocks_used"]
+
+            def boom(*a, **k):
+                raise RuntimeError("insert failed")
+
+            be._insert_span = boom
+            prompt = np.arange(1, 7, dtype=np.int32)
+            kv = {"prompt": prompt,
+                  "k": np.zeros((2, 6, 4, 8), np.float32),
+                  "v": np.zeros((2, 6, 4, 8), np.float32),
+                  "logits": np.zeros(64, np.float32)}
+            with pytest.raises(RuntimeError, match="insert failed"):
+                be.admit_handoff(0, prompt, kv, 4)
+            assert f._pool_mgr.stats_dict()["blocks_used"] == used0, \
+                "failed handoff fold leaked block refs"
+        finally:
+            f.close()
+
+    def test_pool_recovers_after_failed_admissions(self):
+        """The pool still serves real admissions after failures: the
+        give-back is a working settle, not just counter cosmetics."""
+        f = mk_filter(self.BASE + ",pool_blocks:4")
+        try:
+            be = self._backend(f)
+
+            real_insert = be._insert_span
+            state = {"boom": True}
+
+            def flaky(*a, **k):
+                if state["boom"]:
+                    raise RuntimeError("transient")
+                return real_insert(*a, **k)
+
+            be._insert_span = flaky
+            for _ in range(4):      # > pool_blocks failures: would
+                with pytest.raises(RuntimeError):  # exhaust a leaky pool
+                    be.admit(0, np.arange(1, 6, dtype=np.int32), 4)
+            state["boom"] = False
+            be.admit(0, np.arange(1, 6, dtype=np.int32), 4)
+            assert be.blocks[0], "recovered admit did not seat blocks"
+            be.free(0)
+        finally:
+            f.close()
+
+
 class TestKvWire:
     def _roundtrip(self, precision):
         from nnstreamer_tpu.edge.kv import KvReceiver, KvSender

@@ -19,6 +19,7 @@ import numpy as np
 import pytest
 
 from nnstreamer_tpu import Buffer, parse_launch
+from nnstreamer_tpu.analysis.flow import check_identities
 from nnstreamer_tpu.edge.broker import DiscoveryBroker, discover_meta
 from nnstreamer_tpu.filters import register_custom_easy
 from nnstreamer_tpu.serve.router import HashRing, parse_replicas
@@ -277,8 +278,11 @@ class TestRouterE2E:
             assert set(got) <= {2.0 * i for i in range(12)}
             st = rt.stats.snapshot()
             assert st["router_replica_deaths"] >= 1
-            assert st["router_requests"] == \
-                st["router_delivered"] + st["router_shed"]
+            # the declared conservation identity replaces hand-written
+            # counter math: every accepted request was delivered, shed,
+            # or declared orphaned — nothing silently vanished in the
+            # failover
+            check_identities(st, names=["router-settlement"])
             assert st["router_orphaned"] == 0
             rep = rt.router_report()
             assert rep[f"localhost:{ports[victim]}"]["state"] in \
@@ -708,10 +712,9 @@ class TestFleetChaos:
         st = rt.stats.snapshot()
         sent = st["router_requests"]
         assert sent == self.N_CLIENTS * self.N_FRAMES
-        # the router-side ledger balances exactly: declared_lost == 0
-        # means delivered + shed covers every admitted frame
-        assert sent == st["router_delivered"] + st["router_shed"] + \
-            st["router_orphaned"]
+        # the router-side ledger balances exactly: the declared
+        # conservation identity covers every admitted frame
+        check_identities(st, names=["router-settlement"])
         assert st["router_orphaned"] == 0
         assert st["router_replica_deaths"] >= 1
 

@@ -16,6 +16,7 @@ import numpy as np
 import pytest
 
 from nnstreamer_tpu import Buffer, parse_launch
+from nnstreamer_tpu.analysis.flow import check_identities
 from nnstreamer_tpu.filters import register_custom_easy
 from nnstreamer_tpu.serve import BucketBatcher, Request, ServeScheduler, \
     stack_requests
@@ -491,6 +492,11 @@ class TestDrainSettlement:
         assert n_result > 0, "everything shed: nothing was in flight"
         assert server["src"].scheduler.pending() == 0
         assert rep["completed"] == n_result
+        # the declared conservation identity replaces hand-written
+        # counter math: every admitted request reached exactly one
+        # terminal (raises AssertionError with a breakdown otherwise)
+        check_identities({**rep, "pending": 0},
+                         names=["serve-settlement"])
         vals = [float(b.chunks[0].host()[0]) for b in client["out"].buffers]
         assert vals == sorted(vals)  # per-stream order survives the drain
         assert set(vals) <= {float(i) for i in range(sent)}  # serve_slow: id
@@ -509,6 +515,61 @@ class TestDrainSettlement:
         assert server.drain(deadline=10) is True
         assert server.drain(deadline=1) is True  # idempotent
         assert server["src"].scheduler.pending() == 0
+
+
+# ------------------------------------------------- ROI frame settlement
+
+class TestRoiSettlement:
+    """The ROI gate's whole-frame settlement: one terminal per frame
+    (RESULT xor SHED), and a shed frame's still-queued sibling crops
+    are cancelled, not left to burn TPU batches (found by
+    `make flowcheck`: the roi-settlement identity could not balance)."""
+
+    def _element(self, max_queue=16):
+        from nnstreamer_tpu.serve.elements import TensorServeSrc
+        el = TensorServeSrc("roi-src")
+        el.scheduler = ServeScheduler(buckets=(4,), max_wait_s=10.0,
+                                      max_queue=max_queue)
+        sent = []
+        el._send = lambda cid, kind, meta, payloads=(): \
+            sent.append((kind.name, meta))
+        return el, sent
+
+    def _crops_buf(self, n=4):
+        return Buffer.from_arrays(
+            [np.arange(n * 8 * 8 * 3, dtype=np.float32)
+             .reshape(n, 8, 8, 3)], pts=123)
+
+    def test_admission_shed_cancels_sibling_crops(self):
+        """Crop 3 of 4 sheds at admission: the frame settles as ONE
+        SHED, the two already-queued siblings are reclaimed, and the
+        scheduler's own settlement identity balances."""
+        el, sent = self._element(max_queue=2)
+        el._admit_roi(7, self._crops_buf(4), seq=0, roi={"tile": 8})
+        s = el.stats.snapshot()
+        assert s["serve_roi_requests"] == 1 and s["serve_roi_crops"] == 4
+        assert s["serve_roi_shed"] == 1 and s["serve_roi_results"] == 0
+        assert [k for k, _ in sent] == ["SHED"]
+        assert sent[0][1]["retry_after_ms"] > 0
+        # the shed frame's queued siblings were cancelled, not stranded
+        assert el.scheduler.batcher.depth() == 0
+        assert el.scheduler.batcher.stats["cancelled"] == 2
+        check_identities({**el.scheduler.report(), "pending": 0},
+                         names=["serve-settlement"])
+        check_identities({**s, "serve_roi_pending": 0},
+                         names=["roi-settlement"])
+
+    def test_complete_frame_settles_as_one_result(self):
+        el, sent = self._element()
+        el._admit_roi(7, self._crops_buf(4), seq=0, roi={"tile": 8})
+        batch, _bucket, stacked = el.scheduler.next_batch()
+        assert len(batch) == 4
+        el.scheduler.complete(batch, stacked)
+        s = el.stats.snapshot()
+        assert s["serve_roi_results"] == 1 and s["serve_roi_shed"] == 0
+        assert [k for k, _ in sent] == ["RESULT"]
+        check_identities({**s, "serve_roi_pending": 0},
+                         names=["roi-settlement"])
 
 
 # ------------------------------------------------------ satellite: watchdog

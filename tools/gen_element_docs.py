@@ -49,8 +49,11 @@ def _transfer_doc(cls) -> str:
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import nnstreamer_tpu  # noqa: F401 — registers all elements
+    from nnstreamer_tpu.analysis.flow.registry import identities_by_name
     from nnstreamer_tpu.pipeline.registry import (element_names,
                                                   get_element_class)
+
+    identities = identities_by_name()
 
     out = ["# Element reference",
            "",
@@ -73,6 +76,11 @@ def main() -> int:
             out.append("")
         out.append(f"**Caps transfer (pipelint):** {_transfer_doc(cls)}")
         out.append("")
+        for iname in getattr(cls, "SETTLEMENT_IDENTITY", ()) or ():
+            ident = identities[iname]
+            out.append(f"**Settlement identity (flowcheck):** "
+                       f"`{ident.expression}` — {ident.doc}")
+            out.append("")
         fusible = getattr(cls, "DEVICE_FUSIBLE", None)
         if fusible:
             out.append(f"**Device-fusible (fusion compiler):** {fusible}")

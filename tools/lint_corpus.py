@@ -33,6 +33,10 @@ sys.path.insert(0, str(ROOT))
 _SKIP_RE = re.compile(r"#\s*pipelint:\s*skip")
 # shell-ish quoted string that looks like a pipeline description
 _SH_STR_RE = re.compile(r"\"((?:[^\"\\]|\\.)*)\"|'((?:[^'\\]|\\.)*)'", re.S)
+# docs elide caps bodies as "..." — substitute real (flexible) caps so
+# the elision doesn't read as a malformed-caps error
+_ELIDED_CAPS_RE = re.compile(r"caps=\\?[\"'][^\"']*\.\.\.[^\"']*\\?[\"']")
+_FLEX_CAPS = "caps=other/tensors,format=flexible,framerate=(fraction)0/1"
 
 
 def _literal_text(node: ast.AST, env: dict) -> str | None:
@@ -133,20 +137,22 @@ def collect(paths: List[Path]) -> List[Tuple[str, str]]:
         if path.suffix == ".py":
             out.extend(_from_python(text, label))
         else:
-            out.extend(_from_markdown(text, label))
+            out.extend((where, _ELIDED_CAPS_RE.sub(_FLEX_CAPS, desc))
+                       for where, desc in _from_markdown(text, label))
     return out
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("paths", nargs="*", help="files to scan (default: "
-                    "tests/*.py and README.md)")
+                    "tests/*.py, README.md and Documentation/tutorials)")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="print every linted description")
     opts = ap.parse_args(argv)
 
     paths = ([Path(p) for p in opts.paths] if opts.paths else
-             sorted(ROOT.glob("tests/*.py")) + [ROOT / "README.md"])
+             sorted(ROOT.glob("tests/*.py")) + [ROOT / "README.md"]
+             + sorted(ROOT.glob("Documentation/tutorials/*.md")))
 
     from nnstreamer_tpu.analysis import Severity, analyze
     from nnstreamer_tpu.pipeline.parser import parse_launch
