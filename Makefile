@@ -11,7 +11,7 @@ EXAMPLES := $(BUILD)/custom_passthrough.so $(BUILD)/custom_scaler.so
 
 .PHONY: native clean test check tier1 lint racecheck flowcheck chaos \
 	chaos-zeroloss \
-	chaos-fleet chaos-preempt chaos-llm fuse-parity async-parity \
+	chaos-fleet chaos-preempt chaos-llm chaos-elastic fuse-parity async-parity \
 	shard-parity delta-parity obs-overhead package
 
 native: $(LIB) $(EXAMPLES)
@@ -31,6 +31,7 @@ check: native lint racecheck flowcheck
 	$(MAKE) chaos-fleet
 	$(MAKE) chaos-preempt
 	$(MAKE) chaos-llm
+	$(MAKE) chaos-elastic
 	$(MAKE) obs-overhead
 
 # `make fuse-parity` = the fusion compiler's byte-parity oracle: every
@@ -102,6 +103,15 @@ chaos-preempt:
 # tokens lost or duplicated vs the monolithic greedy reference).
 chaos-llm:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_llm_disagg.py -q -m slow
+
+# `make chaos-elastic` = the elastic-fleet acceptance run (slow-marked,
+# excluded from tier-1): random SIGTERMs under load with zero declared
+# loss and both conservation ledgers balancing, a blue/green version
+# swap mid-traffic (every frame settles, the fleet ends all-green), and
+# the compile-cache warm-start budget (first frame <= 2x steady, with a
+# cold control arm proving the gap is real).
+chaos-elastic:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q -m slow
 
 # `make obs-overhead` = the observability cost gate: the devres bench
 # row run with frame tracing on (NNS_TPU_OBS=1) vs hard-off, in

@@ -21,6 +21,8 @@ from .fault import (CircuitBreaker, ErrorPolicy, FaultInjected,
                     TransientError, register_fatal, register_transient)
 from .checkpoint import (PreemptGuard, SnapshotError, SnapshotStore,
                          install_sigterm)
+from .fleet import (Autoscaler, AutoscalerConfig, BlueGreenRollout,
+                    ReplicaSpec, rollout)
 
 __all__ = [
     "Buffer", "Chunk", "Caps", "TensorInfo", "TensorsInfo", "TensorsConfig",
@@ -29,4 +31,6 @@ __all__ = [
     "CircuitBreaker", "ErrorPolicy", "FaultInjected", "TransientError",
     "register_fatal", "register_transient",
     "SnapshotStore", "SnapshotError", "PreemptGuard", "install_sigterm",
+    "Autoscaler", "AutoscalerConfig", "BlueGreenRollout", "ReplicaSpec",
+    "rollout",
 ]

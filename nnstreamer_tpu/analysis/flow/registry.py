@@ -156,6 +156,17 @@ DECLARED_IDENTITIES: Tuple[Identity, ...] = (
         doc="Fleet router conservation: every accepted request is "
             "delivered, shed with retry-after, or declared orphaned "
             "after replica death."),
+    Identity(
+        name="fleet-replica-lifecycle",
+        lhs=_t("replicas_spawned", "fleet/autoscaler.py"),
+        rhs=(_t("replicas_serving", "fleet/autoscaler.py"),
+             _t("replicas_draining", "fleet/autoscaler.py"),
+             _t("replicas_retired", "fleet/autoscaler.py"),
+             _t("replicas_resurrecting", "fleet/autoscaler.py")),
+        doc="Autoscaler conservation: every spawned replica is serving, "
+            "draining toward preemption, retired (exited), or "
+            "resurrecting from its snapshot — scale-down and chaos "
+            "kills book through the same transitions."),
 )
 
 

@@ -17,7 +17,7 @@ import logging
 import os
 import signal
 import threading
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
 
 logger = logging.getLogger(__name__)
 
@@ -41,13 +41,19 @@ class PreemptGuard:
 
     def __init__(self, pipeline, directory: str, grace_s: float = 5.0,
                  retain: int = 3, exit_code: Optional[int] = None,
-                 signum: int = signal.SIGTERM):
+                 signum: int = signal.SIGTERM,
+                 on_done: Optional[Callable[[Optional[Dict]], None]] = None):
         self.pipeline = pipeline
         self.directory = directory
         self.grace_s = float(grace_s)
         self.retain = int(retain)
         self.exit_code = exit_code
         self.signum = signum
+        # last-words hook, called with the preempt report (None when the
+        # preempt itself failed) after the snapshot publishes but BEFORE
+        # os._exit — a fleet replica prints its settlement accounting
+        # here so the parent can audit exact preempt_abandoned counts
+        self.on_done = on_done
         self.done = threading.Event()
         self.report: Optional[Dict] = None
         self._fired = threading.Event()
@@ -78,14 +84,21 @@ class PreemptGuard:
         except BaseException:
             logger.exception("preempt failed; exiting without snapshot")
         finally:
+            if self.on_done is not None:
+                try:
+                    self.on_done(self.report)
+                except BaseException:
+                    logger.exception("preempt on_done hook failed")
             self.done.set()
             if self.exit_code is not None:
                 os._exit(self.exit_code)
 
 
 def install_sigterm(pipeline, directory: str, grace_s: float = 5.0,
-                    retain: int = 3,
-                    exit_code: Optional[int] = None) -> PreemptGuard:
+                    retain: int = 3, exit_code: Optional[int] = None,
+                    on_done: Optional[Callable[[Optional[Dict]], None]]
+                    = None) -> PreemptGuard:
     """Convenience wrapper: build + install a :class:`PreemptGuard`."""
     return PreemptGuard(pipeline, directory, grace_s=grace_s,
-                        retain=retain, exit_code=exit_code).install()
+                        retain=retain, exit_code=exit_code,
+                        on_done=on_done).install()

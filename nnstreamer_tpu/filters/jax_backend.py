@@ -86,6 +86,9 @@ class JaxFilter(FilterFramework):
         self._props: Optional[FilterProperties] = None
         self._lock = threading.Lock()
         self._suspended = False
+        # persistent compile cache identity (fleet/cache.py): model URI
+        # + mesh spec — donation variants key per entry, not per model
+        self._cache_key = ""
 
     # -- lifecycle --------------------------------------------------------
     def open(self, props: FilterProperties) -> None:
@@ -112,6 +115,43 @@ class JaxFilter(FilterFramework):
                 self._params = jax.device_put(self._params, self._device)
             logger.info("jax filter opened model=%s on %s", model,
                         self._device)
+        self._cache_key = f"{model}|mesh={opts.get('mesh', '')}"
+        self._prewarm_from_cache()
+
+    def _prewarm_from_cache(self) -> None:
+        """Replay every signature this model compiled in previous lives
+        (fleet/cache.py): the jit cache is hot BEFORE the first frame
+        arrives — and before a serve pipeline REGISTERs on the broker —
+        so a resurrected or scaled-up replica's first-frame latency is
+        steady-state, not compile-bound."""
+        from ..fleet import cache as compile_cache
+        cc = compile_cache.active()
+        if cc is None or self._apply is None:
+            return
+        cc.enable_xla_cache()
+        import jax
+        warmed = 0
+        for sig, donate in cc.signatures("jax", self._cache_key):
+            if donate and (self._device is None or self._device.platform
+                           not in self._DONATION_PLATFORMS):
+                donate = ()  # recorded on a donating platform; not here
+            try:
+                xs = [np.zeros(shape, dtype) for shape, dtype in sig]
+                if self._mesh is not None:
+                    xs = self._place_inputs(xs)
+                else:
+                    xs = [jax.device_put(x, self._device) for x in xs]
+                out = self._executable(sig, donate)(self._params, *xs)
+                jax.block_until_ready(out)
+                warmed += 1
+            except Exception as exc:
+                # a stale signature (model shape change across versions)
+                # only costs its own replay, never the open
+                logger.info("jax filter: cached signature %s skipped: %s",
+                            sig, exc)
+        if warmed:
+            logger.info("jax filter: prewarmed %d signature(s) for %s",
+                        warmed, self._cache_key)
 
     def _load_model(self, model: str, props: FilterProperties) -> None:
         if model.startswith("zoo://"):
@@ -172,7 +212,22 @@ class JaxFilter(FilterFramework):
             exe = jax.jit(call, donate_argnums=donate_idx) if donate_idx \
                 else jax.jit(call)
             self._jit_cache[key] = exe
+            self._record_signature(sig, donate_idx)
         return exe
+
+    def _record_signature(self, sig: Tuple,
+                          donate_idx: Tuple[int, ...]) -> None:
+        """Persist a freshly-compiled signature so the NEXT incarnation
+        of this model prewarms it (no-op without an installed cache)."""
+        from ..fleet import cache as compile_cache
+        cc = compile_cache.active()
+        if cc is None or not self._cache_key:
+            return
+        try:
+            cc.record("jax", self._cache_key, sig, donate_idx)
+        except Exception as exc:  # cache IO is never allowed to fail serving
+            logger.warning("jax filter: compile-cache record failed: %s",
+                           exc)
 
     @property
     def mesh(self):

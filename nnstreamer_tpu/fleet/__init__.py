@@ -1,0 +1,30 @@
+"""Elastic fleet: autoscaler control plane, preemptible replicas, and
+zero-downtime blue/green rollouts.
+
+The layer that closes the loop between the telemetry plane (router PONG
+loads, ``/metrics``) and fleet size: replicas are subprocess serve
+pipelines that are **preemptible by default** (SIGTERM → PreemptGuard →
+snapshot → router drain settlement), scale-down *is* a preemption, and
+an unexpected death resurrects from its own snapshot. The persistent
+compile cache keeps every spawn warm before it advertises readiness.
+
+See ``Documentation/robustness.md`` ("Elastic fleet") for the ladder
+rung and the grace-budget math; ``tests/test_fleet.py`` is the chaos
+harness driving all of it.
+"""
+from .autoscaler import (Autoscaler, AutoscalerConfig, DRAINING,
+                         RESURRECTING, SERVING, live_autoscalers)
+from .cache import CompileCache, active as active_compile_cache, \
+    deactivate as deactivate_compile_cache, install as install_compile_cache
+from .replica import ReplicaProcess, ReplicaSpec
+from .rollout import BlueGreenRollout, rollout
+
+__all__ = [
+    "Autoscaler", "AutoscalerConfig",
+    "SERVING", "DRAINING", "RESURRECTING",
+    "live_autoscalers",
+    "ReplicaProcess", "ReplicaSpec",
+    "BlueGreenRollout", "rollout",
+    "CompileCache", "install_compile_cache", "active_compile_cache",
+    "deactivate_compile_cache",
+]

@@ -80,7 +80,7 @@ class FusedSegment(TransformElement):
         self._mesh = next(
             (m for m in (getattr(getattr(e, "fw", None), "mesh", None)
                          for e in members) if m is not None), None)
-        self.stats.update(jit_hits=0, jit_misses=0, shed=0,
+        self.stats.update(jit_hits=0, jit_misses=0, jit_prewarmed=0, shed=0,
                           breaker_opened=0, fused_elements=len(members),
                           devices=(len(self._mesh.devices.ravel())
                                    if self._mesh is not None else 1))
@@ -140,6 +140,52 @@ class FusedSegment(TransformElement):
                 reorder_deadline_s=float(self.reorder_deadline_ms) / 1e3,
                 devices=(len(self._mesh.devices.ravel())
                          if self._mesh is not None else 1))
+        self._prewarm_from_cache()
+
+    def _cache_key(self) -> str:
+        """Segment identity for the persistent compile cache: the
+        member names (launch-string stable) — the same fused run in a
+        resurrected replica maps to the same signature bucket."""
+        return "+".join(m.name for m in self.members)
+
+    def _prewarm_from_cache(self) -> None:
+        """Compile (and execute once, on zeros) every caps signature
+        this segment's previous incarnations served, so the first real
+        frame hits a warm program (fleet/cache.py)."""
+        from ..fleet import cache as compile_cache
+        cc = compile_cache.active()
+        if cc is None:
+            return
+        cc.enable_xla_cache()
+        import jax
+        import numpy as np
+        for sig, _donate in cc.signatures("fusion", self._cache_key()):
+            if sig in self._programs:
+                continue
+            try:
+                arrays = [np.zeros(shape, dtype) for shape, dtype in sig]
+                if self._mesh is not None:
+                    from ..parallel.sharding import place_batch
+                    arrays = place_batch(arrays, self._mesh)
+                exe = self._compile()
+                jax.block_until_ready(exe(arrays))
+                self._programs[sig] = exe
+                self.stats.inc("jit_prewarmed")
+            except Exception as exc:
+                # a stale signature only costs its own replay
+                logger.info("%s: cached fused signature %s skipped: %s",
+                            self.name, sig, exc)
+
+    def _record_signature(self, sig) -> None:
+        from ..fleet import cache as compile_cache
+        cc = compile_cache.active()
+        if cc is None:
+            return
+        try:
+            cc.record("fusion", self._cache_key(), sig)
+        except Exception as exc:  # cache IO must never fail the chain
+            logger.warning("%s: compile-cache record failed: %s",
+                           self.name, exc)
 
     def drain(self) -> None:
         super().drain()
@@ -203,7 +249,8 @@ class FusedSegment(TransformElement):
             arrays = place_batch(arrays, self._mesh)
         t0 = time.perf_counter_ns()
         exe = self._programs.get(sig)
-        if exe is None:
+        missed = exe is None
+        if missed:
             self.stats.inc("jit_misses")
             exe = self._compile()
         else:
@@ -221,6 +268,8 @@ class FusedSegment(TransformElement):
                 self._breaker.record_failure()
             raise
         self._programs[sig] = exe
+        if missed:
+            self._record_signature(sig)
         dt = time.perf_counter_ns() - t0
         tracer = getattr(self.pipeline, "tracer", None)
         if tracer is not None:

@@ -294,6 +294,36 @@ def render() -> str:
             lines.append(f"# TYPE nns_delta_{key} gauge")
             lines.append(f"nns_delta_{key} {val}")
 
+    # 3d) elastic fleet: live autoscalers expose the replica lifecycle
+    # (the conservation identity's terms) as per-state gauges — what a
+    # dashboard needs to see scale events and in-progress rollouts
+    from ..fleet.autoscaler import live_autoscalers
+    autos = live_autoscalers()
+    if autos:
+        lines.append("# TYPE nns_fleet_replicas gauge")
+        lines.append("# TYPE nns_fleet_lifecycle_total counter")
+    for auto in sorted(autos, key=lambda a: a.name):
+        try:
+            states = auto.replicas()
+            life = auto.lifecycle()
+        except Exception:  # noqa: BLE001 — a scrape never takes the runtime down
+            continue
+        by_state: Dict[str, int] = {}
+        for st in states.values():
+            by_state[st] = by_state.get(st, 0) + 1
+        for st in ("serving", "draining", "resurrecting"):
+            lines.append(
+                f"nns_fleet_replicas"
+                f"{_labels(autoscaler=auto.name, state=st)}"
+                f" {by_state.get(st, 0)}")
+        for k, v in sorted(life.items()):
+            n = _num(v)
+            if n is None:
+                continue
+            lines.append(
+                f"nns_fleet_lifecycle_total"
+                f"{_labels(autoscaler=auto.name, counter=k)} {n}")
+
     # 4) attached tracers: the full report, flattened — every
     # Counters/Reservoir trace.py aggregates becomes a series
     emitted_trace_type = False

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import socket
 import threading
+import uuid
 from typing import Dict, Optional, Tuple
 
 import numpy as np
@@ -85,7 +86,12 @@ class TensorServeSrc(SrcElement):
              # ("prefill" | "decode" | "both"; "" = not an LLM replica)
              # so the fleet router can steer prompt frames to prefill
              # capacity and pin each stream's decode home
-             "llm-role": ""}
+             "llm-role": "",
+             # model/config version tag, advertised on REGISTER and
+             # every PONG load report: the fleet's blue/green rollout
+             # verifies the whole ring converged on the new version
+             # before retiring the old one ("" = unversioned)
+             "version": ""}
 
     # the scheduler records queue_wait + batch spans on the request ctx
     SPAN_POINTS = ("queue-wait", "batch", "chain")
@@ -113,6 +119,10 @@ class TensorServeSrc(SrcElement):
         self._clock = threading.Lock()
         self.scheduler: Optional[ServeScheduler] = None
         self._broker_sock: Optional[socket.socket] = None
+        # per-incarnation token (reminted by every start()), echoed in
+        # CAPS_ACK so a fleet router can tell "reconnect to the same
+        # process life" from "a new process at the same endpoint"
+        self._instance = uuid.uuid4().hex[:12]
         self.stats["link_errors"] = 0
         self.stats.update({"serve_roi_requests": 0, "serve_roi_crops": 0,
                            "serve_roi_shed": 0, "serve_roi_results": 0})
@@ -131,6 +141,7 @@ class TensorServeSrc(SrcElement):
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
+        self._instance = uuid.uuid4().hex[:12]
         self.scheduler = ServeScheduler(
             buckets=[int(b) for b in str(self.buckets).split(",") if b],
             max_wait_s=float(self.max_wait_ms) / 1e3,
@@ -162,6 +173,8 @@ class TensorServeSrc(SrcElement):
                 reg_meta = dict(self.scheduler.occupancy(), role="serve")
                 if str(self.llm_role):
                     reg_meta["llm_role"] = str(self.llm_role)
+                if str(self.version):
+                    reg_meta["version"] = str(self.version)
                 if self._restored is not None:
                     # resurrection announcement: the router counts these
                     # and knows the replica carries restored session ids
@@ -255,7 +268,8 @@ class TensorServeSrc(SrcElement):
                         entry = self._conns.get(cid)
                         if entry is not None:
                             self._conns[cid] = (entry[0], entry[1], cfg)
-                    ack = {"caps": _FLEX_CAPS, "client_id": cid}
+                    ack = {"caps": _FLEX_CAPS, "client_id": cid,
+                           "instance": self._instance}
                     if cfg is not None:
                         ack["wire"] = cfg.to_meta()
                     send_msg(conn, MsgKind.CAPS_ACK, ack)
@@ -275,6 +289,8 @@ class TensorServeSrc(SrcElement):
                             if self.scheduler is not None else {})
                     if str(self.llm_role):
                         load = dict(load, llm_role=str(self.llm_role))
+                    if str(self.version):
+                        load = dict(load, version=str(self.version))
                     self._send(cid, MsgKind.PONG,
                                {"t": meta.get("t"), "load": load})
                 elif kind == MsgKind.EOS:

@@ -108,7 +108,8 @@ class _Replica:
     between the dispatching client threads and the heartbeat timer."""
 
     __slots__ = ("key", "host", "port", "origin", "sock", "slock", "cfg",
-                 "gen", "hb", "breaker", "draining", "load")
+                 "gen", "hb", "breaker", "draining", "load", "instance",
+                 "restored_ad")
 
     def __init__(self, key: str, host: str, port: int, origin: str,
                  heartbeat_s: float, heartbeat_miss: int,
@@ -125,6 +126,14 @@ class _Replica:
                                       name=f"replica:{key}")
         self.draining = False
         self.load: Dict = {}
+        # the serve src's per-incarnation token (CAPS_ACK): a re-dial
+        # that lands on the SAME process is a reconnect, not a rejoin —
+        # it must not clear an administrative drain ("" = pre-token peer)
+        self.instance = ""
+        # whether the broker advert last seen for this endpoint carried
+        # restored_sessions: resurrection counting is edge-triggered on
+        # this, so a same-endpoint resurrect counts exactly once
+        self.restored_ad = False
 
     @property
     def llm_role(self) -> str:
@@ -189,6 +198,12 @@ class FleetRouter:
             "router_orphaned": 0, "router_orphan_drops": 0,
             "router_replica_deaths": 0,
             "router_replica_connects": 0, "router_replica_drains": 0,
+            # pre-seeded (not lazily minted on first event) so report()
+            # and /metrics expose them as 0 from the first scrape — a
+            # dashboard watching for the first rejoin/resurrection must
+            # not have to special-case a missing series
+            "router_replica_rejoins": 0,
+            "router_replica_resurrections": 0,
             "link_errors": 0})
         self._listener = TcpListener(host, port, self._client_conn,
                                      name=f"router-accept:{name}")
@@ -541,16 +556,26 @@ class FleetRouter:
                 pass
             return False
         rejoined = False
+        inst = str(meta.get("instance") or "")
         with self._rlock:
+            # the serve src mints a fresh instance token per start(): a
+            # matching token means this re-dial reached the SAME process
+            # life — a TCP blip, not a membership event
+            same_proc = bool(inst) and inst == rep.instance
+            rep.instance = inst
             rep.sock = sock
             rep.slock = threading.Lock()
             rep.cfg = cfg
             rep.gen += 1
             rep.hb = Heartbeat(self.heartbeat_s, self.heartbeat_miss)
-            # a fresh link is a fresh replica: a process resurrected at
-            # the same host:port must not inherit the corpse's DRAINING
-            # flag (it would be routable never again)
-            if rep.draining:
+            # a fresh link to a NEW process is a fresh replica: one
+            # resurrected at the same host:port must not inherit the
+            # corpse's DRAINING flag (it would be routable never again).
+            # A reconnect to the same still-draining process keeps the
+            # flag — clearing it would undo an administrative drain and
+            # double-count the rejoin (the mid-drain counter drift this
+            # guard exists for).
+            if rep.draining and not same_proc:
                 rep.draining = False
                 rejoined = True
             self._rebuild_ring_locked()
@@ -758,17 +783,32 @@ class FleetRouter:
                                    self.breaker_reset_s)
                     self._replicas[key] = rep
                     fresh.append(rep)
-                if isinstance(info, dict) and not rep.load:
-                    rep.load = info  # REGISTER occupancy seeds the load
-                if isinstance(info, dict) and info.get("restored_sessions") \
-                        and rep in fresh:
+                if isinstance(info, dict) and (not rep.load
+                                               or rep.sock is None):
+                    # REGISTER occupancy seeds the load; a down replica's
+                    # stale PONG load is replaced by the fresh advert
+                    rep.load = info
+                has_rs = (isinstance(info, dict)
+                          and bool(info.get("restored_sessions")))
+                if has_rs and not rep.restored_ad:
                     # the replica came back from a preemption snapshot
-                    # carrying restored session ids: count the
-                    # resurrection (chaos asserts it happened exactly once)
+                    # carrying restored session ids. Edge-triggered on
+                    # the advert (a registration's advert dies with its
+                    # broker connection), so a resurrection counts once
+                    # whether the process came back at a brand-new
+                    # endpoint or at the SAME host:port — the latter
+                    # was previously never counted
                     self.stats.inc("router_replica_resurrections")
                     logger.info("%s: replica %s resurrected with %d "
                                 "restored session(s)", self.name, key,
                                 len(info["restored_sessions"]))
+                rep.restored_ad = has_rs
+            for k, r in self._replicas.items():
+                if k not in seen:
+                    # its advert died with its registration connection;
+                    # the next advert carrying restored_sessions is a
+                    # fresh resurrection edge
+                    r.restored_ad = False
             # a replica the broker no longer advertises AND whose link is
             # gone has left the fleet; a live link outranks a flapping
             # broker, so connected members are never evicted here

@@ -29,7 +29,7 @@ from ..obs import events as _obs_events
 from ..obs import spans as _obs_spans
 from ..utils.atomic import Counters
 from ..utils.log import logger
-from ..utils.trace import Reservoir
+from ..utils.trace import Reservoir, WindowReservoir
 from .batcher import BucketBatcher, Request, stack_requests
 
 # serve_src/serve_sink pairing by id (≙ the query elements' SERVER_TABLE)
@@ -79,7 +79,9 @@ class ServeScheduler:
         self._stop_evt = threading.Event()
         self.tracer = None  # optional utils.trace.Tracer (observe() sink)
         self._mlock = threading.Lock()
-        self._queue_delay = Reservoir()
+        # queue delay is the autoscaler's control signal: windowed, so
+        # a drained backlog stops reading as pressure within seconds
+        self._queue_delay = WindowReservoir(window_s=2.0)
         self._batch_latency = Reservoir()
         self.stats = Counters(completed=0, rows_padded=0, bucket_rows=0,
                               result_errors=0, invoke_errors=0,
@@ -289,7 +291,10 @@ class ServeScheduler:
         return {"depth": b["depth"], "streams": b["streams"],
                 "occupancy_avg": round(filled / s["bucket_rows"], 4)
                 if s["bucket_rows"] else 0.0,
-                "queue_delay_us_p50": round(qd["p50"] / 1e3, 1)}
+                "queue_delay_us_p50": round(qd["p50"] / 1e3, 1),
+                # the tail the autoscaler's control law acts on (its
+                # target is a p95, not a median)
+                "queue_delay_us_p95": round(qd["p95"] / 1e3, 1)}
 
     def report(self) -> Dict[str, Any]:
         """Occupancy, queue delay and batch latency percentiles, shed

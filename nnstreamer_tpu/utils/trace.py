@@ -26,7 +26,8 @@ from __future__ import annotations
 import random
 import threading
 import time
-from typing import Any, Dict, Sequence
+from collections import deque
+from typing import Any, Dict, Optional, Sequence
 
 # bounded per-series sample budget: 512 f64 samples = 4 KB per element,
 # enough for +/- a few percent on p99 at streaming rates
@@ -121,6 +122,51 @@ class Reservoir:
 
     def percentiles(self, qs: Sequence[int] = (50, 95, 99)) -> Dict[str, float]:
         s = sorted(self.samples)
+        out: Dict[str, float] = {}
+        for q in qs:
+            if not s:
+                out[f"p{q}"] = 0.0
+            else:
+                out[f"p{q}"] = s[min(len(s) - 1,
+                                     int(round(q / 100.0 * (len(s) - 1))))]
+        return out
+
+
+class WindowReservoir:
+    """Time-windowed percentiles: samples older than ``window_s`` fall
+    out. An all-stream reservoir is right for post-hoc tail reporting
+    but wrong as a *control signal* — a burst's 300ms queue delays
+    would linger in it long after the backlog drained, so an autoscaler
+    reading p95 would never see recovery and never scale down. Bounded
+    at ``k`` samples (newest win) so a burst can't grow memory."""
+
+    __slots__ = ("window_s", "k", "n", "_buf")
+
+    def __init__(self, window_s: float = 2.0, k: int = _RESERVOIR_K):
+        self.window_s = max(1e-3, float(window_s))
+        self.k = max(1, int(k))
+        self.n = 0
+        self._buf: deque = deque()  # (t_mono, value), oldest first
+
+    def _prune(self, now: float) -> None:
+        horizon = now - self.window_s
+        buf = self._buf
+        while buf and (buf[0][0] < horizon or len(buf) > self.k):
+            buf.popleft()
+
+    def add(self, value: float, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        self.n += 1
+        self._buf.append((now, value))
+        self._prune(now)
+
+    def samples(self, now: Optional[float] = None) -> list:
+        self._prune(time.monotonic() if now is None else now)
+        return [v for _, v in self._buf]
+
+    def percentiles(self, qs: Sequence[int] = (50, 95, 99),
+                    now: Optional[float] = None) -> Dict[str, float]:
+        s = sorted(self.samples(now))
         out: Dict[str, float] = {}
         for q in qs:
             if not s:

@@ -738,3 +738,40 @@ class TestDeltaRules:
         ok = (f"tensortestsrc caps={CAPS_U8} ! "
               "tensor_delta name=d mode=gate ! fakesink")
         assert findings_for(ok, "delta-lossy-gate-feeds-trainer") == []
+
+
+class TestAutoscalerConfigRule:
+    def test_inverted_bounds_error(self):
+        bad = (  # pipelint: skip — floor above the ceiling
+            "tensor_autoscaler name=a router=rt "
+            "min-replicas=5 max-replicas=2")
+        got = findings_for(bad, "autoscaler-config")
+        assert [(f.element, f.severity) for f in got] == \
+            [("a", Severity.ERROR)]
+        assert "min-replicas=5 > max-replicas=2" in got[0].message
+
+    def test_nonpositive_drain_deadline_error(self):
+        bad = (  # pipelint: skip — zero drain deadline orphans work
+            "tensor_autoscaler name=a router=rt drain-deadline-ms=0")
+        got = findings_for(bad, "autoscaler-config")
+        assert [(f.element, f.severity) for f in got] == \
+            [("a", Severity.ERROR)]
+        assert "drain-deadline-ms" in got[0].message
+
+    def test_no_metrics_source_warns(self):
+        blind = (  # pipelint: skip — nothing feeds the control law
+            "tensor_autoscaler name=a min-replicas=1 max-replicas=3")
+        got = findings_for(blind, "autoscaler-config")
+        assert [(f.element, f.severity) for f in got] == \
+            [("a", Severity.WARNING)]
+        assert "metrics source" in got[0].message
+
+    def test_metrics_url_counts_as_source(self):
+        ok = ("tensor_autoscaler name=a max-replicas=3 "
+              "metrics-url=http://localhost:9090/metrics")
+        assert findings_for(ok, "autoscaler-config") == []
+
+    def test_routered_autoscaler_is_clean(self):
+        ok = ("tensor_autoscaler name=a router=rt "
+              "min-replicas=1 max-replicas=4 drain-deadline-ms=2000")
+        assert findings_for(ok, "autoscaler-config") == []

@@ -681,6 +681,32 @@ class TestPercentiles:
             b.add(float(v))
         assert a.samples == b.samples
 
+    def test_window_reservoir_forgets_old_pressure(self):
+        # deterministic clock via explicit `now`: burst-era samples must
+        # fall out of the window, or an autoscaler reading p95 as its
+        # control signal would never see recovery (and never scale down)
+        from nnstreamer_tpu.utils.trace import WindowReservoir
+        r = WindowReservoir(window_s=2.0)
+        for i in range(50):
+            r.add(300_000.0, now=10.0 + i * 0.01)  # 300ms burst delays
+        assert r.percentiles(qs=(95,), now=10.5)["p95"] == 300_000.0
+        for i in range(20):
+            r.add(500.0, now=13.0 + i * 0.01)      # quiet again
+        p = r.percentiles(qs=(50, 95), now=13.2)
+        assert p["p95"] == 500.0 and p["p50"] == 500.0
+        assert r.n == 70  # lifetime count survives the pruning
+
+    def test_window_reservoir_bounded_and_empty_window(self):
+        from nnstreamer_tpu.utils.trace import WindowReservoir
+        r = WindowReservoir(window_s=60.0, k=16)
+        for i in range(1000):
+            r.add(float(i), now=100.0 + i * 1e-4)
+        assert len(r._buf) <= 17  # k newest (+1 transient before prune)
+        r2 = WindowReservoir(window_s=1.0)
+        r2.add(42.0, now=5.0)
+        r2.add(43.0, now=99.0)  # first sample long expired
+        assert r2.percentiles(qs=(95,), now=99.0)["p95"] == 43.0
+
     def test_tracer_report_has_percentile_columns(self):
         tr = Tracer()
         for v in (1, 2, 3, 4, 100):
