@@ -9,7 +9,8 @@ SHELL := /bin/bash
 LIB := $(BUILD)/libnnstpu.so
 EXAMPLES := $(BUILD)/custom_passthrough.so $(BUILD)/custom_scaler.so
 
-.PHONY: native clean test check tier1 lint racecheck flowcheck chaos \
+.PHONY: native clean test check tier1 lint racecheck flowcheck jitcheck \
+	jit-stability chaos \
 	chaos-zeroloss \
 	chaos-fleet chaos-preempt chaos-llm chaos-elastic fuse-parity async-parity \
 	shard-parity delta-parity obs-overhead package
@@ -20,9 +21,10 @@ native: $(LIB) $(EXAMPLES)
 # non-slow test suite on the 8-virtual-device CPU mesh
 # (tests/conftest.py forces JAX_PLATFORMS=cpu) + a packaging sanity
 # check.
-check: native lint racecheck flowcheck
+check: native lint racecheck flowcheck jitcheck
 	python -m pytest tests/ -q -m 'not slow'
 	python -c "import nnstreamer_tpu as nt; print('import ok:', len(nt.pipeline.registry.element_names()), 'elements')"
+	$(MAKE) jit-stability
 	$(MAKE) fuse-parity
 	$(MAKE) async-parity
 	$(MAKE) shard-parity
@@ -141,6 +143,22 @@ racecheck:
 # nothing). JSON report lands in build/flowcheck.json for CI artifacts.
 flowcheck:
 	env JAX_PLATFORMS=cpu python -m nnstreamer_tpu flowcheck nnstreamer_tpu --min-acquire-sites 10 -o build/flowcheck.json
+
+# `make jitcheck` = the compile/host-sync gate: no hidden host syncs,
+# retrace hazards, donation-after-use, or impure compiled bodies in the
+# hot path (reasoned # jitcheck: ok() suppressions excepted).
+# --min-hot-sites guards against a refactor silently unhooking the
+# role model. JSON report lands in build/jitcheck.json for CI.
+jitcheck:
+	env JAX_PLATFORMS=cpu python -m nnstreamer_tpu jitcheck nnstreamer_tpu --min-hot-sites 20 -o build/jitcheck.json
+
+# `make jit-stability` = the runtime half of jitcheck: the builtin
+# corpus runs to steady state twice against one persistent CompileCache
+# — any second-pass frame-path compilation, any observed compile kind
+# the static scan can't see, or a corpus that recorded no signatures at
+# all fails the gate (tools/jit_stability.py).
+jit-stability:
+	env JAX_PLATFORMS=cpu python tools/jit_stability.py
 
 # `make lint` = static gates: bytecode-compile the package, then run
 # pipelint over every pipeline description in tests/ and README.md

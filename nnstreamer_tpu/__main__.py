@@ -26,6 +26,11 @@ Settlement / conservation analysis (flowcheck)::
     python -m nnstreamer_tpu flowcheck nnstreamer_tpu/
     python -m nnstreamer_tpu flowcheck --json -o build/flowcheck.json
 
+Compile/host-sync analysis (jitcheck)::
+
+    python -m nnstreamer_tpu jitcheck nnstreamer_tpu/
+    python -m nnstreamer_tpu jitcheck --json -o build/jitcheck.json
+
 Fleet telemetry (scrapes obs metrics endpoints into one table)::
 
     python -m nnstreamer_tpu top --targets localhost:9100,localhost:9101
@@ -114,6 +119,9 @@ def main(argv=None) -> int:
     if argv and argv[0] == "flowcheck":
         from .analysis.flow.cli import main as flowcheck_main
         return flowcheck_main(argv[1:])
+    if argv and argv[0] == "jitcheck":
+        from .analysis.jit.cli import main as jitcheck_main
+        return jitcheck_main(argv[1:])
     if argv and argv[0] == "top":
         from .obs.top import main as top_main
         return top_main(argv[1:])

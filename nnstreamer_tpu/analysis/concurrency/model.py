@@ -595,14 +595,23 @@ def _spawn_role(target: str, model: Model, cls_name: str) -> str:
     return WORKER
 
 
-def roles_of(model: Model, cls_name: str) -> Dict[str, Set[str]]:
+def roles_of(
+    model: Model,
+    cls_name: str,
+    extra_seeds: Optional[List[Tuple[str, str, str]]] = None,
+) -> Dict[str, Set[str]]:
     """method name -> roles, for the class viewed as concrete (its own
-    + inherited methods resolved nearest-definition-first)."""
+    + inherited methods resolved nearest-definition-first).
+
+    ``extra_seeds`` lets sibling analyzers (jitcheck) graft additional
+    (ancestor, method, role) entry points onto the same propagation
+    without disturbing racecheck's defaults."""
     eff = model.effective_methods(cls_name)
     roles: Dict[str, Set[str]] = {name: set() for name in eff}
     ancestry = set(model.ancestry(cls_name))
 
-    for base, meth, role in DEFAULT_SEEDS:
+    seeds = DEFAULT_SEEDS if not extra_seeds else DEFAULT_SEEDS + extra_seeds
+    for base, meth, role in seeds:
         if base in ancestry and meth in roles:
             roles[meth].add(role)
     for name in roles:

@@ -1,0 +1,36 @@
+"""jitcheck — static JAX compile/host-sync hazard analysis.
+
+The fourth analyzer in the family: pipelint validates pipeline GRAPHS,
+racecheck the lock discipline of the CODE, flowcheck the settlement
+LEDGER — jitcheck proves the hot path stays on-device. It rides on
+racecheck's thread-role model to find the bodies a frame actually
+crosses, tracks device-array taint through them, and reports hidden
+host syncs, silent retrace triggers, donation-after-use, and impurity
+inside compiled functions; a runtime compile-stability monitor
+(``make jit-stability``) then cross-checks the static jit-site map
+against what a warmed process actually compiles.
+
+    from nnstreamer_tpu.analysis.jit import analyze_paths
+    report = analyze_paths(["nnstreamer_tpu/"])
+    assert report.exit_code == 0, report.to_text()
+
+See Documentation/jitcheck.md for the taint model, the finding
+classes, and the ``# jitcheck: ok(reason)`` suppression pragma.
+"""
+from .findings import (DONATION_MISUSE, HOST_SYNC, IMPURE_DEVICE_FN,
+                       RETRACE, VACUOUS_COVERAGE, JitFinding, JitReport)
+from .model import (EXTRA_SEEDS, HOT_ROLES, FuncUnit, JitBinding,
+                    JitModel, JitSite, scan_paths, site_kind)
+from .passes import analyze_paths, run_passes
+from .runtime import (CompileEventMonitor, StabilityResult,
+                      check_against_static, jit_stat_snapshot,
+                      steady_recompiles)
+
+__all__ = [
+    "analyze_paths", "run_passes", "scan_paths", "JitModel", "FuncUnit",
+    "JitBinding", "JitSite", "JitFinding", "JitReport", "HOST_SYNC",
+    "RETRACE", "DONATION_MISUSE", "IMPURE_DEVICE_FN", "VACUOUS_COVERAGE",
+    "HOT_ROLES", "EXTRA_SEEDS", "site_kind", "CompileEventMonitor",
+    "StabilityResult", "check_against_static", "jit_stat_snapshot",
+    "steady_recompiles",
+]

@@ -1,0 +1,70 @@
+"""``python -m nnstreamer_tpu jitcheck`` — the compile/host-sync lint CLI.
+
+    jitcheck [paths...] [--json] [-o FILE] [-q] [-v] [--min-hot-sites N]
+
+Scans the given files/directories (default: the installed
+``nnstreamer_tpu`` package) and reports host-sync-in-hot-path,
+retrace-hazard, donation-misuse, and impure-device-fn findings.
+``--min-hot-sites`` turns the scan's own coverage into a finding: if
+fewer hot-path bodies than N were actually walked, the gate fails
+rather than silently passing on an unhooked model. Exit codes:
+0 clean, 1 findings, 2 usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from .passes import analyze_paths
+
+USAGE_ERROR = 2
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="nnstreamer_tpu jitcheck",
+        description="static JAX compile/host-sync hazard analyzer "
+                    "(host syncs, retrace hazards, donation misuse, "
+                    "impure device fns) for the streaming runtime")
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories to scan (default: the "
+                         "nnstreamer_tpu package)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit machine-readable findings")
+    ap.add_argument("-o", "--output", metavar="FILE",
+                    help="also write the report (JSON) to FILE")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="suppress output; exit code only")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="list suppressed findings too")
+    ap.add_argument("--min-hot-sites", type=int, default=0, metavar="N",
+                    help="fail (vacuous-coverage) unless at least N "
+                         "hot-path bodies were analyzed")
+    try:
+        opts = ap.parse_args(argv)
+    except SystemExit as exc:
+        # argparse exits 2 on bad flags and 0 on --help: keep both
+        return int(exc.code or 0) and USAGE_ERROR
+
+    paths = opts.paths or [str(Path(__file__).resolve().parents[2])]
+    for p in paths:
+        if not Path(p).exists():
+            print(f"jitcheck: no such path: {p}", file=sys.stderr)
+            return USAGE_ERROR
+
+    report = analyze_paths(paths, min_hot_sites=opts.min_hot_sites)
+
+    if opts.output:
+        out = Path(opts.output)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(report.to_json() + "\n", encoding="utf-8")
+    if not opts.quiet:
+        print(report.to_json() if opts.json
+              else report.to_text(verbose=opts.verbose))
+    return report.exit_code
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

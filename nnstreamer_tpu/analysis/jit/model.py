@@ -1,0 +1,424 @@
+"""AST scan + hot-path model for jitcheck.
+
+jitcheck rides on racecheck's thread-role machinery: the same class
+model and seed-propagated role map decide WHICH bodies are hot (chain,
+source-loop, dispatcher, completer, worker, uploader — the threads a
+frame crosses between source and sink), and jitcheck then walks those
+bodies with its own device-taint tracker. Separately it collects every
+``jax.jit`` construction in the tree (the *static* compile-site map the
+runtime gate checks observed CompileCache kinds against) plus the
+bodies those constructions compile (``device_fn`` inner programs,
+decorated ops, fused-segment programs), which get the purity and
+retrace passes instead of the host-sync pass.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..concurrency.model import (CHAIN, COMPLETER, DISPATCHER, SOURCE,
+                                 UPLOADER, WORKER, Model, live_roles,
+                                 roles_of)
+from ..concurrency.model import scan_paths as _scan_roles
+
+PRAGMA_RE = re.compile(r"#\s*jitcheck:\s*ok\(([^)]*)\)")
+
+# roles whose bodies sit on the frame path — a hidden sync here stalls
+# the pipeline, not just one caller.
+HOT_ROLES = frozenset({CHAIN, SOURCE, WORKER, DISPATCHER, COMPLETER,
+                       UPLOADER})
+
+# jitcheck-specific role entry points grafted onto racecheck's seeds:
+# cross-object calls (element -> framework, decoder registry -> plugin,
+# batcher -> scheduler) that intra-class propagation cannot reach.
+EXTRA_SEEDS: List[Tuple[str, str, str]] = [
+    ("FilterFramework", "invoke", CHAIN),
+    ("FilterFramework", "dispatch", DISPATCHER),
+    ("FilterFramework", "complete", COMPLETER),
+    ("DecoderPlugin", "decode", CHAIN),
+    ("ServeScheduler", "complete", WORKER),
+    ("OverlapExecutor", "submit", DISPATCHER),
+]
+
+# (ancestor, method) -> parameter names that carry device arrays when
+# the method runs (the taint seeds a signature implies).
+DEVICE_PARAMS: Dict[Tuple[str, str], Tuple[str, ...]] = {
+    ("ServeScheduler", "complete"): ("outputs",),
+    # only the jax backend's dispatch handle holds device arrays — the
+    # interop/simulated backends hand host objects around.
+    ("JaxFilter", "complete"): ("handle",),
+}
+
+# methods whose inner ``def`` bodies the fusion planner / backends hand
+# to jax.jit — those inner bodies are device programs.
+COMPILED_WRAPPERS = frozenset({"device_fn", "_compile", "traceable_fn"})
+
+# attribute reads that return host metadata, never a device value
+META_ATTRS = frozenset({"shape", "ndim", "dtype", "size", "nbytes",
+                        "sharding", "is_device", "done", "dev", "name"})
+
+# trailing call names whose RESULT is a device array (taint sources)
+DEVICE_PRODUCERS = frozenset({"invoke", "dispatch", "device_put",
+                              "place_batch", "with_sharding_constraint",
+                              "tile_error"})
+
+# .host() / jax.device_get() are the sanctioned materialization points
+SANITIZERS = frozenset({"host", "device_get", "block_host"})
+
+
+def site_kind(file: str) -> str:
+    """Map a jit construction site to the CompileCache ``kind`` bucket
+    the runtime half will observe for it."""
+    p = file.replace("\\", "/")
+    if "/fusion/" in p:
+        return "fusion"
+    if "/filters/" in p:
+        return "jax"
+    if "/ops/" in p:
+        return "ops"
+    if "/models/" in p:
+        return "models"
+    if "/parallel/" in p:
+        return "parallel"
+    if "/trainers/" in p:
+        return "trainer"
+    return Path(p).stem
+
+
+@dataclass(frozen=True)
+class JitSite:
+    file: str
+    line: int
+    kind: str
+
+
+@dataclass(frozen=True)
+class JitBinding:
+    """A name bound to a jitted callable (``f = jax.jit(fn, ...)`` or a
+    jit decorator) — call sites of the name get the retrace checks."""
+    name: str                       # "step" or "self._decode"
+    file: str
+    line: int
+    static_argnums: Tuple[int, ...] = ()
+    static_argnames: Tuple[str, ...] = ()
+    donate_argnums: Tuple[int, ...] = ()
+
+
+@dataclass
+class FuncUnit:
+    """One analyzable body: a method, module function, or inner def."""
+    file: str
+    cls: Optional[str]
+    name: str
+    node: ast.AST                   # FunctionDef / AsyncFunctionDef
+    roles: Set[str] = field(default_factory=set)
+    tainted_params: Set[str] = field(default_factory=set)
+    compiled: bool = False          # body is traced/compiled by jax.jit
+
+    @property
+    def qual(self) -> str:
+        return f"{self.cls}.{self.name}" if self.cls else self.name
+
+    @property
+    def hot(self) -> bool:
+        return bool(self.roles & HOT_ROLES)
+
+
+@dataclass
+class JitModel:
+    roles_model: Optional[Model] = None
+    units: List[FuncUnit] = field(default_factory=list)
+    bindings: Dict[Tuple[str, str], JitBinding] = field(default_factory=dict)
+    jit_sites: List[JitSite] = field(default_factory=list)
+    pragmas: Dict[str, Dict[int, str]] = field(default_factory=dict)
+    num_files: int = 0
+
+    def pragma_reason(self, file: str, lineno: int) -> Optional[str]:
+        """pragma on the line itself or the line above."""
+        table = self.pragmas.get(file, {})
+        return table.get(lineno) or table.get(lineno - 1)
+
+    def binding(self, file: str, name: str) -> Optional[JitBinding]:
+        return self.bindings.get((file, name))
+
+
+# -- per-file collection ----------------------------------------------------
+
+def _trailing_attr(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+class _FileCollector:
+    """Walks one module: finds jit constructions + bindings, classifies
+    function bodies into units, and marks compiled bodies."""
+
+    def __init__(self, model: JitModel, ro_model: Model, label: str):
+        self.model = model
+        self.ro = ro_model
+        self.label = label
+        self.jax_names: Set[str] = {"jax"}
+        self.jit_names: Set[str] = set()       # from jax import jit [as j]
+        self.partial_names: Set[str] = {"partial", "functools"}
+
+    # -- jit construction recognition --
+    def is_jit_func(self, func: ast.AST) -> bool:
+        if isinstance(func, ast.Attribute) and func.attr == "jit":
+            return _root_name(func) in self.jax_names
+        if isinstance(func, ast.Name):
+            return func.id in self.jit_names
+        return False
+
+    def jit_call_of(self, node: ast.AST) -> Optional[ast.Call]:
+        """Return the jax.jit(...) Call inside ``node`` if node is a jit
+        construction: jax.jit(...), partial(jax.jit, ...), or the bare
+        jax.jit / imported jit name used as a decorator."""
+        if isinstance(node, ast.Call):
+            if self.is_jit_func(node.func):
+                return node
+            if (_trailing_attr(node.func) in ("partial",)
+                    and node.args and self.is_jit_func(node.args[0])):
+                return node
+        return None
+
+    def is_jit_decorator(self, dec: ast.AST) -> Optional[ast.Call]:
+        call = self.jit_call_of(dec)
+        if call is not None:
+            return call
+        if self.is_jit_func(dec):
+            return ast.Call(func=dec, args=[], keywords=[])  # bare @jax.jit
+        return None
+
+    @staticmethod
+    def _const_ints(node: ast.AST) -> Tuple[int, ...]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            return (node.value,)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out = []
+            for e in node.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    out.append(e.value)
+            return tuple(out)
+        return ()
+
+    @staticmethod
+    def _const_strs(node: ast.AST) -> Tuple[str, ...]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return (node.value,)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return tuple(e.value for e in node.elts
+                         if isinstance(e, ast.Constant)
+                         and isinstance(e.value, str))
+        return ()
+
+    def binding_from(self, name: str, call: ast.Call,
+                     line: int) -> JitBinding:
+        statics: Tuple[int, ...] = ()
+        argnames: Tuple[str, ...] = ()
+        donate: Tuple[int, ...] = ()
+        for kw in call.keywords:
+            if kw.arg == "static_argnums":
+                statics = self._const_ints(kw.value)
+            elif kw.arg == "static_argnames":
+                argnames = self._const_strs(kw.value)
+            elif kw.arg == "donate_argnums":
+                donate = self._const_ints(kw.value)
+        return JitBinding(name=name, file=self.label, line=line,
+                          static_argnums=statics, static_argnames=argnames,
+                          donate_argnums=donate)
+
+    def note_site(self, node: ast.AST) -> None:
+        self.model.jit_sites.append(JitSite(
+            file=self.label, line=getattr(node, "lineno", 0),
+            kind=site_kind(self.label)))
+
+    # -- module walk --
+    def scan(self, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "jax":
+                        self.jax_names.add(a.asname or "jax")
+                    elif a.name == "functools":
+                        self.partial_names.add(a.asname or "functools")
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "jax":
+                    for a in node.names:
+                        if a.name == "jit":
+                            self.jit_names.add(a.asname or "jit")
+                elif node.module == "functools":
+                    for a in node.names:
+                        if a.name == "partial":
+                            self.partial_names.add(a.asname or "partial")
+
+        # every jit construction anywhere in the tree is a site
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and self.jit_call_of(node):
+                self.note_site(node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if not isinstance(dec, ast.Call) and self.is_jit_func(dec):
+                        self.note_site(dec)   # bare @jax.jit decorator
+
+        for stmt in tree.body:
+            if isinstance(stmt, ast.ClassDef):
+                self._scan_class(stmt)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_function(stmt, cls=None, roles={"api"})
+                for inner in ast.walk(stmt):
+                    if isinstance(inner, ast.Assign):
+                        self._scan_binding_assign(
+                            inner, list(stmt.body), cls=None)
+            elif isinstance(stmt, ast.Assign):
+                self._scan_binding_assign(stmt, tree.body, cls=None)
+
+    def _scan_binding_assign(self, stmt: ast.Assign, scope_body,
+                             cls: Optional[str]) -> None:
+        call = self.jit_call_of(stmt.value) if isinstance(
+            stmt.value, ast.Call) else None
+        if call is None:
+            return
+        for tgt in stmt.targets:
+            name = None
+            if isinstance(tgt, ast.Name):
+                name = tgt.id
+            elif (isinstance(tgt, ast.Attribute)
+                  and isinstance(tgt.value, ast.Name)
+                  and tgt.value.id == "self"):
+                name = f"self.{tgt.attr}"
+            if name:
+                key = (self.label, f"{cls}.{name}" if cls else name)
+                self.model.bindings[key] = self.binding_from(
+                    name, call, stmt.lineno)
+        # jax.jit(fn) over a sibling def marks fn's body compiled
+        if call.args and isinstance(call.args[0], ast.Name):
+            self._mark_compiled_def(call.args[0].id, scope_body)
+
+    def _mark_compiled_def(self, fname: str, scope_body) -> None:
+        for s in scope_body:
+            if (isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and s.name == fname):
+                for u in self.model.units:
+                    if u.node is s:
+                        u.compiled = True
+                        return
+                self._add_unit(s, cls=None, roles=set(), compiled=True)
+                return
+
+    def _add_unit(self, node, cls, roles, compiled=False,
+                  tainted: Optional[Set[str]] = None) -> FuncUnit:
+        unit = FuncUnit(file=self.label, cls=cls, name=node.name,
+                        node=node, roles=set(roles),
+                        tainted_params=set(tainted or ()),
+                        compiled=compiled)
+        self.model.units.append(unit)
+        return unit
+
+    def _decorated_jit(self, node) -> Optional[ast.Call]:
+        for dec in node.decorator_list:
+            call = self.is_jit_decorator(dec)
+            if call is not None:
+                return call
+        return None
+
+    def _scan_function(self, node, cls: Optional[str],
+                       roles: Set[str],
+                       tainted: Optional[Set[str]] = None) -> None:
+        dec_call = self._decorated_jit(node)
+        unit = self._add_unit(node, cls, roles, compiled=bool(dec_call),
+                              tainted=tainted)
+        if dec_call is not None:
+            key = (self.label, f"{cls}.{node.name}" if cls else node.name)
+            self.model.bindings[key] = self.binding_from(
+                node.name, dec_call, node.lineno)
+        self._scan_inner(node, outer_compiled=bool(dec_call),
+                         wrapper=node.name in COMPILED_WRAPPERS)
+
+    def _scan_inner(self, node, outer_compiled: bool,
+                    wrapper: bool) -> None:
+        """Inner defs: compiled if the enclosing scope jits them (by
+        name or by being a COMPILED_WRAPPERS method), else skipped —
+        they run in the enclosing body's role and the walker inlines
+        nothing."""
+        body = list(node.body)
+        jitted_names: Set[str] = set()
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                call = self.jit_call_of(n)
+                if call is not None and call.args and isinstance(
+                        call.args[0], ast.Name):
+                    jitted_names.add(call.args[0].id)
+        for s in body:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if (wrapper or outer_compiled or s.name in jitted_names
+                        or self._decorated_jit(s) is not None
+                        or s.name in COMPILED_WRAPPERS):
+                    self._add_unit(s, cls=None, roles=set(), compiled=True)
+                    self._scan_inner(s, outer_compiled=True, wrapper=False)
+
+    def _scan_class(self, cnode: ast.ClassDef) -> None:
+        roles_map = roles_of(self.ro, cnode.name, extra_seeds=EXTRA_SEEDS)
+        ancestry = set(self.ro.ancestry(cnode.name)) | {cnode.name}
+        for stmt in cnode.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                roles = live_roles(roles_map.get(stmt.name, {"api"}))
+                tainted: Set[str] = set()
+                for (base, meth), params in DEVICE_PARAMS.items():
+                    if base in ancestry and meth == stmt.name:
+                        tainted.update(params)
+                self._scan_function(stmt, cls=cnode.name, roles=roles,
+                                    tainted=tainted)
+                for inner in ast.walk(stmt):
+                    if isinstance(inner, ast.Assign):
+                        self._scan_binding_assign(
+                            inner, list(stmt.body), cls=cnode.name)
+
+
+def scan_paths(paths: Sequence[str]) -> JitModel:
+    """Parse every ``.py`` under the given files/directories into one
+    JitModel (racecheck's role model rides along for the hot-path
+    classification). Unparseable files are skipped — compileall's
+    problem, not jitcheck's."""
+    ro_model = _scan_roles(paths)
+    model = JitModel(roles_model=ro_model)
+    files: List[Path] = []
+    for p in paths:
+        path = Path(p)
+        if path.is_dir():
+            files.extend(sorted(path.rglob("*.py")))
+        elif path.suffix == ".py":
+            files.append(path)
+    seen: Set[Path] = set()
+    for path in files:
+        rp = path.resolve()
+        if rp in seen:
+            continue
+        seen.add(rp)
+        try:
+            source = path.read_text(encoding="utf-8")
+            tree = ast.parse(source)
+        except (OSError, SyntaxError, UnicodeDecodeError):
+            continue
+        label = str(path)
+        model.num_files += 1
+        table: Dict[int, str] = {}
+        for n, line in enumerate(source.splitlines(), 1):
+            m = PRAGMA_RE.search(line)
+            if m:
+                table[n] = m.group(1).strip() or "unspecified"
+        if table:
+            model.pragmas[label] = table
+        _FileCollector(model, ro_model, label).scan(tree)
+    return model

@@ -572,6 +572,7 @@ class TensorFilter(Element):
             self._dispatch_windowed(buf, inputs)
             return
         t0 = time.perf_counter_ns()
+        c0 = getattr(self.fw, "compile_count", 0)
         try:
             if self.invoke_async:
                 # ctx rides along with the invoke so each dispatched
@@ -580,6 +581,7 @@ class TensorFilter(Element):
                 # fallback for backends that don't thread ctx through
                 self._async_template = buf
                 self.fw.invoke_async(inputs, ctx=buf)
+                self._note_recompiles(c0)
                 self._record_dispatch(time.perf_counter_ns() - t0)
                 self._record_latency(time.perf_counter_ns() - t0)
                 return
@@ -596,6 +598,7 @@ class TensorFilter(Element):
             return
         if self._breaker is not None:
             self._breaker.record_success()
+        self._note_recompiles(c0)
         # synchronous path: dispatch and completion are the same event
         dt = time.perf_counter_ns() - t0
         self._record_dispatch(dt)
@@ -622,6 +625,7 @@ class TensorFilter(Element):
         thread. The chain thread never waits on the device."""
         t_disp = self._overlap.window.acquire()
         t0 = time.perf_counter_ns()
+        c0 = getattr(self.fw, "compile_count", 0)
         try:
             handle = self.fw.dispatch(inputs,
                                       donate=bool(self.donate_input))
@@ -639,6 +643,7 @@ class TensorFilter(Element):
             self._settle_failed_rows(buf)
             return
         try:
+            self._note_recompiles(c0)
             self._record_dispatch(time.perf_counter_ns() - t0)
             self._overlap.submit(buf, handle, t_disp)
         except BaseException:
@@ -848,6 +853,16 @@ class TensorFilter(Element):
         self.push(buf)
 
     # -- stats ------------------------------------------------------------
+    def _note_recompiles(self, c0: int) -> None:
+        """Frame-path compilations: the backend's jit cache missed
+        DURING a frame invoke/dispatch (warmup and cache prewarm don't
+        route through here, so they never count). A warmed process must
+        hold this at zero — `make jit-stability` pins it, and
+        /metrics exports it as nns_jit_recompiles_total."""
+        d = getattr(self.fw, "compile_count", 0) - c0
+        if d > 0:
+            self.stats.add(jit_recompiles=d)
+
     def _record_latency(self, dt_ns: int) -> None:
         """Record one frame's dispatch-to-COMPLETION latency. Sync path:
         chain thread; windowed path: completer thread — every mutation

@@ -86,6 +86,11 @@ class JaxFilter(FilterFramework):
         self._props: Optional[FilterProperties] = None
         self._lock = threading.Lock()
         self._suspended = False
+        # monotonically counts jit-cache misses (actual trace+compile),
+        # warmup and prewarm included — the element baselines it at
+        # start() so its jit_recompiles stat counts only frame-path
+        # compiles (the jit-stability gate pins those to zero)
+        self.compile_count = 0
         # persistent compile cache identity (fleet/cache.py): model URI
         # + mesh spec — donation variants key per entry, not per model
         self._cache_key = ""
@@ -212,6 +217,7 @@ class JaxFilter(FilterFramework):
             exe = jax.jit(call, donate_argnums=donate_idx) if donate_idx \
                 else jax.jit(call)
             self._jit_cache[key] = exe
+            self.compile_count += 1
             self._record_signature(sig, donate_idx)
         return exe
 

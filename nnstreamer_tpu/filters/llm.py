@@ -642,7 +642,9 @@ class LlmFilter(FilterFramework):
                 tok = self._sample_host(sub, logits, temperature)
             else:
                 tok = jnp.argmax(logits, -1)
-            emit(np.asarray(tok, np.int32))
+            # per-token emit IS the streaming boundary: materialize via
+            # the sanctioned device_get, not an implicit __array__ sync
+            emit(jax.device_get(tok).astype(np.int32))
             if i + 1 >= max_tokens or pos >= max_len:
                 return  # nothing left to decode: skip the trailing step
             logits, cache = self._decode(self._params, cache,
@@ -675,7 +677,7 @@ class LlmFilter(FilterFramework):
                     tok = self._sample_host(sub, logits, temperature)
                 else:
                     tok = jnp.argmax(logits, -1)
-                emit(np.asarray(tok, np.int32))
+                emit(jax.device_get(tok).astype(np.int32))
                 return
             toks, logits, mcache, keys = self._chunk_fn(k, temperature)(
                 self._params, mcache, logits, keys, active)
@@ -1019,7 +1021,7 @@ class LlmFilter(FilterFramework):
             else:
                 tok = jnp.argmax(backend.logits, -1)
             tok = tok.astype(jnp.int32)
-            tok_host = np.asarray(tok)
+            tok_host = jax.device_get(tok)  # ONE fetch for all slots
             for slot, s in enumerate(streams):
                 if s is None:
                     continue

@@ -31,6 +31,8 @@ import os
 import threading
 from typing import Dict, List, Optional, Tuple
 
+import numpy as np
+
 from ..checkpoint.store import SnapshotError, SnapshotStore
 from ..utils.log import logger
 
@@ -43,12 +45,30 @@ _SIGS_FILE = "signatures.json"
 SigEntry = Tuple[Tuple[Tuple[Tuple[int, ...], str], ...], Tuple[int, ...]]
 
 
+def canon_dtype(dtype) -> str:
+    """Canonical dtype spelling: ``'<f4'``, ``'=f4'``, ``'single'``,
+    ``np.float32`` and ``'float32'`` are ONE signature, not five. An
+    alias spelling in the registry would prewarm one jit-cache entry
+    and then still miss at invoke time (which keys on ``str(x.dtype)``)
+    — a genuine double compile of the same logical program. Dtypes
+    NumPy doesn't know (``bfloat16`` on builds without ml_dtypes
+    registration) keep their string form, which is already canonical
+    on the producing side."""
+    try:
+        return np.dtype(dtype).name          # objects, np types, '<f4'
+    except TypeError:
+        try:
+            return np.dtype(str(dtype)).name  # dtype-like reprs
+        except TypeError:
+            return str(dtype)
+
+
 def _sig_to_json(sig) -> list:
-    return [[list(shape), str(dtype)] for shape, dtype in sig]
+    return [[list(shape), canon_dtype(dtype)] for shape, dtype in sig]
 
 
 def _sig_from_json(data) -> Tuple:
-    return tuple((tuple(int(d) for d in shape), str(dtype))
+    return tuple((tuple(int(d) for d in shape), canon_dtype(dtype))
                  for shape, dtype in data)
 
 
@@ -132,6 +152,18 @@ class CompileCache:
             except (KeyError, TypeError, ValueError):
                 continue  # one malformed entry must not spoil the rest
         return out
+
+    def kinds(self) -> List[str]:
+        """Distinct compile kinds ("jax", "fusion", ...) that recorded
+        at least one signature — the observed half of jitcheck's
+        static↔runtime contract."""
+        with self._lock:
+            return sorted({k.split(":", 1)[0] for k in self._sigs})
+
+    def entry_count(self) -> int:
+        """Total recorded signatures across all model keys."""
+        with self._lock:
+            return sum(len(v) for v in self._sigs.values())
 
     def enable_xla_cache(self) -> bool:
         """Best-effort: point JAX's persistent compilation cache at a

@@ -197,6 +197,7 @@ def render() -> str:
 
     # 2) per-element counters of every registered pipeline
     emitted_counter_type = False
+    emitted_jit_type = False
     for p in pipelines:
         pname = getattr(p, "name", "") or ""
         for e in getattr(p, "elements", {}).values():
@@ -215,6 +216,16 @@ def render() -> str:
                     f"nns_element_counter_total"
                     f"{_labels(pipeline=pname, element=e.name, counter=k)}"
                     f" {n}")
+                if k == "jit_recompiles":
+                    # first-class family: frame-path compiles per filter
+                    # (jitcheck's runtime contract — zero once warm)
+                    if not emitted_jit_type:
+                        lines.append(
+                            "# TYPE nns_jit_recompiles_total counter")
+                        emitted_jit_type = True
+                    lines.append(
+                        f"nns_jit_recompiles_total"
+                        f"{_labels(pipeline=pname, element=e.name)} {n}")
 
     # 3) serve schedulers: live occupancy gauges + reservoir quantiles
     from ..serve.scheduler import SERVE_TABLE, _TABLE_LOCK

@@ -250,7 +250,11 @@ class ServeScheduler:
         request and are dropped). A failing per-row callback (its client
         died mid-reply) must not starve the other rows of the batch."""
         now = time.monotonic()
-        hosts = [np.asarray(o) for o in outputs]
+        import jax
+        # ONE batched D2H transfer for every device output (host arrays
+        # pass through device_get untouched) — a per-array np.asarray
+        # here is an implicit __array__ sync per tensor per batch
+        hosts = [np.asarray(o) for o in jax.device_get(list(outputs))]
         for i, req in enumerate(batch):
             row = [np.ascontiguousarray(h[i]) if h.ndim >= 1
                    and h.shape[0] >= len(batch) else h for h in hosts]

@@ -310,6 +310,7 @@ class JaxTrainer(TrainerFramework):
                 opt_state = jax.tree_util.tree_map(jnp.asarray,
                                                    self._resume_opt)
             else:
+                # jitcheck: ok(one-shot optimizer init at train start, not per-step)
                 opt_state = jax.jit(opt.init)(self.params)
 
             @jax.jit

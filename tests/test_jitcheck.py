@@ -1,0 +1,651 @@
+"""jitcheck: static JAX compile/host-sync hazard analyzer + runtime gate.
+
+Seeds one fixture module per defect class and asserts the analyzer
+reports the right rule at the right ``file:line`` — without importing,
+let alone running, the fixture code. Mirrors test_racecheck.py: defect
+corpus + clean corpus + pragma scoping + CLI exit-code contract
+(0 clean / 1 findings / 2 usage error), plus the runtime half: the
+CompileCache signature canonicalization and the static↔runtime
+compile-stability contract.
+"""
+import json
+import textwrap
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.analysis.jit import (DONATION_MISUSE, HOST_SYNC,
+                                         IMPURE_DEVICE_FN, RETRACE,
+                                         VACUOUS_COVERAGE, analyze_paths,
+                                         check_against_static,
+                                         jit_stat_snapshot, site_kind,
+                                         steady_recompiles)
+from nnstreamer_tpu.analysis.jit.cli import main as jitcheck_main
+from nnstreamer_tpu.fleet.cache import CompileCache, canon_dtype
+
+PACKAGE_DIR = Path(__file__).resolve().parents[1] / "nnstreamer_tpu"
+
+
+def check(tmp_path, source, name="fixture.py", rule=None):
+    """Write one fixture module, scan it, return (findings, report)."""
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(source))
+    report = analyze_paths([str(f)])
+    if rule is None:
+        return report.findings, report
+    return report.by_rule(rule), report
+
+
+# --------------------------------------------------------------- fixtures
+# Module-level constants carry NO base indentation so line numbers in the
+# written file match the literal, and targeted str.replace stays honest.
+
+HOT_ITEM = """\
+import jax.numpy as jnp
+
+class Element:      # role seed: Element.chain runs on the chain thread
+    pass
+
+class Probe(Element):
+    def chain(self, pad, buf):
+        y = jnp.abs(buf.raw)
+        return y.item()            # line 9: blocking D2H on the hot path
+"""
+
+HOT_CAST = """\
+import jax.numpy as jnp
+
+class Element:
+    pass
+
+class Caster(Element):
+    def chain(self, pad, buf):
+        y = jnp.square(buf.raw)
+        v = float(y)               # line 9: scalar cast forces a sync
+        return v
+"""
+
+HOT_TRUTH = """\
+import jax.numpy as jnp
+
+class Element:
+    pass
+
+class Truthy(Element):
+    def chain(self, pad, buf):
+        y = jnp.abs(buf.raw)
+        if y:                      # line 9: implicit bool() blocks
+            return y
+        return None
+"""
+
+HOT_NP = """\
+import numpy as np
+
+class Element:
+    pass
+
+class Npcopy(Element):
+    def chain(self, pad, buf):
+        x = buf.raw
+        return np.asarray(x)       # line 9: implicit __array__ D2H copy
+"""
+
+HOT_BLOCK = """\
+class Element:
+    pass
+
+class Waiter(Element):
+    def chain(self, pad, buf):
+        out = self.fw.invoke(buf.raw)
+        out[0].block_until_ready()     # line 7: not the completer role
+        return out
+"""
+
+CLEAN_HOST = """\
+import jax.numpy as jnp
+
+class Element:
+    pass
+
+class Boundary(Element):
+    def chain(self, pad, buf):
+        y = jnp.abs(buf.raw)
+        if y.shape[0] > 4:          # host metadata: no sync
+            return None
+        host = y.host()             # sanctioned materialization point
+        return float(host[0])
+"""
+
+RETRACE_CREATE_CALL = """\
+import jax
+
+class Element:
+    pass
+
+class PerCall(Element):
+    def chain(self, pad, buf):
+        return jax.jit(self.step)(buf.raw)     # line 8: per-call compile
+"""
+
+RETRACE_LOOP = """\
+import jax
+
+class Element:
+    pass
+
+class Looper(Element):
+    def chain(self, pad, buf):
+        outs = []
+        for x in buf.chunks:
+            f = jax.jit(self.step)      # line 10: fresh cache per iter
+            outs.append(f(x))
+        return outs
+"""
+
+RETRACE_STATIC = """\
+import jax
+
+class Element:
+    pass
+
+class Stepper(Element):
+    def __init__(self, step):
+        self._step = jax.jit(step, static_argnums=(1,))
+
+    def chain(self, pad, buf):
+        return self._step(buf.raw, [4, 4])      # line 11: unhashable
+"""
+
+RETRACE_SET_UNPACK = """\
+import jax
+
+class Element:
+    pass
+
+class SetFeed(Element):
+    def __init__(self, step):
+        self._step = jax.jit(step)
+
+    def chain(self, pad, buf):
+        return self._step(*set(buf.parts))      # line 11: set order
+"""
+
+RETRACE_SHAPE = """\
+def device_fn(scale):
+    def fn(x):
+        if x.shape[0] > 4:          # line 3: compiles per shape
+            return x * scale
+        return x
+    return fn
+"""
+
+RETRACE_DATA = """\
+import jax.numpy as jnp
+
+def device_fn(scale):
+    def fn(x):
+        if jnp.sum(x) > 0:          # line 5: traces per value
+            return x * scale
+        return x
+    return fn
+"""
+
+DONATED_READ = """\
+class Element:
+    pass
+
+class Donor(Element):
+    def chain(self, pad, buf):
+        x = buf.raw
+        handle = self.fw.dispatch(x, donate=True)
+        y = x * 2                   # line 8: read after donate
+        return handle, y
+"""
+
+DONATED_REBIND = """\
+class Element:
+    pass
+
+class Rebinder(Element):
+    def chain(self, pad, buf):
+        x = buf.raw
+        handle = self.fw.dispatch(x, donate=True)
+        x = handle[0]               # rebinding clears the donation
+        return x * 2
+"""
+
+IMPURE_COUNTER = """\
+class Backend:
+    def device_fn(self):
+        def fn(x):
+            self.counters.inc("frames")     # line 4: trace-time only
+            return x * 2
+        return fn
+"""
+
+IMPURE_PRINT = """\
+import jax
+
+@jax.jit
+def step(x):
+    print("tracing", x)     # line 5: I/O runs once at trace time
+    return x + 1
+"""
+
+IMPURE_STORE = """\
+import jax
+
+@jax.jit
+def accum(x):
+    total[0] = x            # line 5: write to captured state
+    return x
+"""
+
+CLEAN_COMPILED = """\
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x):
+    y = jnp.tanh(x)
+    return y * 2
+"""
+
+
+# ---------------------------------------------------------- host-sync rule
+
+class TestHostSync:
+    def test_item_located(self, tmp_path):
+        found, _ = check(tmp_path, HOT_ITEM, "probe.py", HOST_SYNC)
+        assert len(found) == 1
+        assert found[0].line == 9
+        assert found[0].cls == "Probe" and found[0].func == "chain"
+        assert "chain" in found[0].roles
+
+    def test_scalar_cast_located(self, tmp_path):
+        found, _ = check(tmp_path, HOT_CAST, "cast.py", HOST_SYNC)
+        assert [f.line for f in found] == [9]
+        assert "float()" in found[0].message
+
+    def test_implicit_truthiness_located(self, tmp_path):
+        found, _ = check(tmp_path, HOT_TRUTH, "truth.py", HOST_SYNC)
+        assert [f.line for f in found] == [9]
+        assert "bool()" in found[0].message
+
+    def test_np_conversion_located(self, tmp_path):
+        found, _ = check(tmp_path, HOT_NP, "npcopy.py", HOST_SYNC)
+        assert [f.line for f in found] == [9]
+
+    def test_block_until_ready_outside_completer(self, tmp_path):
+        found, _ = check(tmp_path, HOT_BLOCK, "waiter.py", HOST_SYNC)
+        assert [f.line for f in found] == [7]
+        assert "completer" in found[0].message
+
+    def test_metadata_and_host_boundary_clean(self, tmp_path):
+        found, report = check(tmp_path, CLEAN_HOST, "boundary.py")
+        assert found == []
+        assert report.hot_sites == 1
+
+    def test_cold_code_not_walked(self, tmp_path):
+        # same sync, but in a class with no hot role: out of scope
+        cold = HOT_ITEM.replace("(Element)", "")
+        found, report = check(tmp_path, cold, "cold.py")
+        assert found == []
+        assert report.hot_sites == 0
+
+
+# ------------------------------------------------------------ retrace rule
+
+class TestRetrace:
+    def test_create_and_call_located(self, tmp_path):
+        found, _ = check(tmp_path, RETRACE_CREATE_CALL, "percall.py",
+                         RETRACE)
+        assert [f.line for f in found] == [8]
+
+    def test_jit_in_loop_located(self, tmp_path):
+        found, _ = check(tmp_path, RETRACE_LOOP, "looper.py", RETRACE)
+        assert [f.line for f in found] == [10]
+        assert "loop" in found[0].message
+
+    def test_unhashable_static_arg(self, tmp_path):
+        found, _ = check(tmp_path, RETRACE_STATIC, "stepper.py", RETRACE)
+        assert [f.line for f in found] == [11]
+        assert "static" in found[0].message
+
+    def test_hashable_static_arg_clean(self, tmp_path):
+        fixed = RETRACE_STATIC.replace("[4, 4]", "(4, 4)")
+        found, _ = check(tmp_path, fixed, "stepper.py")
+        assert found == []
+
+    def test_set_unpack_into_jitted_signature(self, tmp_path):
+        found, _ = check(tmp_path, RETRACE_SET_UNPACK, "setfeed.py",
+                         RETRACE)
+        assert [f.line for f in found] == [11]
+
+    def test_shape_branch_in_compiled_body(self, tmp_path):
+        found, report = check(tmp_path, RETRACE_SHAPE, "shapes.py",
+                              RETRACE)
+        assert [f.line for f in found] == [3]
+        assert report.compiled_bodies == 1
+
+    def test_data_dependent_branch_in_compiled_body(self, tmp_path):
+        found, _ = check(tmp_path, RETRACE_DATA, "datadep.py", RETRACE)
+        assert [f.line for f in found] == [5]
+        assert "data-dependent" in found[0].message
+
+
+# ----------------------------------------------------------- donation rule
+
+class TestDonation:
+    def test_read_after_donate_located(self, tmp_path):
+        found, _ = check(tmp_path, DONATED_READ, "donor.py",
+                         DONATION_MISUSE)
+        assert [f.line for f in found] == [8]
+        assert "line 7" in found[0].message   # names the donation site
+
+    def test_rebind_clears_donation(self, tmp_path):
+        found, _ = check(tmp_path, DONATED_REBIND, "rebinder.py")
+        assert found == []
+
+    def test_nondonating_dispatch_clean(self, tmp_path):
+        plain = DONATED_READ.replace(", donate=True", "")
+        found, _ = check(tmp_path, plain, "donor.py")
+        assert found == []
+
+
+# ------------------------------------------------------------- purity rule
+
+class TestImpureDeviceFn:
+    def test_counter_bump_located(self, tmp_path):
+        found, _ = check(tmp_path, IMPURE_COUNTER, "backend.py",
+                         IMPURE_DEVICE_FN)
+        assert [f.line for f in found] == [4]
+        assert "trace time" in found[0].message
+
+    def test_io_located(self, tmp_path):
+        found, _ = check(tmp_path, IMPURE_PRINT, "printer.py",
+                         IMPURE_DEVICE_FN)
+        assert [f.line for f in found] == [5]
+
+    def test_captured_store_located(self, tmp_path):
+        found, _ = check(tmp_path, IMPURE_STORE, "accum.py",
+                         IMPURE_DEVICE_FN)
+        assert [f.line for f in found] == [5]
+
+    def test_pure_compiled_body_clean(self, tmp_path):
+        found, report = check(tmp_path, CLEAN_COMPILED, "step.py")
+        assert found == []
+        assert report.compiled_bodies == 1
+        assert report.jit_sites == 1
+
+
+# ------------------------------------------------------------------ corpus
+
+class TestCorpus:
+    def test_four_distinct_finding_classes(self, tmp_path):
+        """The full seeded corpus pins all four classes to file:line."""
+        seeds = {"sync.py": (HOT_ITEM, HOST_SYNC, 9),
+                 "retrace.py": (RETRACE_CREATE_CALL, RETRACE, 8),
+                 "donate.py": (DONATED_READ, DONATION_MISUSE, 8),
+                 "impure.py": (IMPURE_COUNTER, IMPURE_DEVICE_FN, 4)}
+        for name, (src, _, _) in seeds.items():
+            (tmp_path / name).write_text(src)
+        report = analyze_paths([str(tmp_path)])
+        got = {(f.rule, Path(f.file).name, f.line)
+               for f in report.findings}
+        want = {(rule, name, line)
+                for name, (_, rule, line) in seeds.items()}
+        assert got == want
+        assert report.exit_code == 1
+
+    def test_clean_corpus_is_clean(self, tmp_path):
+        for name, src in [("boundary.py", CLEAN_HOST),
+                          ("rebinder.py", DONATED_REBIND),
+                          ("step.py", CLEAN_COMPILED)]:
+            (tmp_path / name).write_text(src)
+        report = analyze_paths([str(tmp_path)])
+        assert report.findings == []
+        assert report.exit_code == 0
+
+
+# ----------------------------------------------------------------- pragmas
+
+class TestPragmas:
+    def test_pragma_suppresses_with_reason(self, tmp_path):
+        src = HOT_ITEM.replace(
+            "return y.item()  ",
+            "return y.item()  # jitcheck: ok(probe boundary)")
+        found, report = check(tmp_path, src, "probe.py")
+        assert found == []
+        assert len(report.suppressed) == 1
+        assert report.suppressed[0].rule == HOST_SYNC
+        assert report.exit_code == 0
+
+    def test_pragma_on_line_above(self, tmp_path):
+        src = HOT_ITEM.replace(
+            "        return y.item()",
+            "        # jitcheck: ok(probe boundary)\n"
+            "        return y.item()")
+        found, report = check(tmp_path, src, "probe.py")
+        assert found == []
+        assert len(report.suppressed) == 1
+
+    def test_pragma_elsewhere_does_not_blanket(self, tmp_path):
+        src = "# jitcheck: ok(not here)\n" + HOT_ITEM
+        found, report = check(tmp_path, src, "probe.py", HOST_SYNC)
+        assert len(found) == 1
+        assert report.exit_code == 1
+
+
+# --------------------------------------------------------------- self-scan
+
+class TestSelfScan:
+    def test_self_scan_is_clean(self):
+        """The package's own hot path carries no live findings, and the
+        scan is not vacuous: it actually walks the runtime."""
+        report = analyze_paths([str(PACKAGE_DIR)], min_hot_sites=20)
+        assert report.findings == [], report.to_text()
+        assert report.hot_sites >= 20
+        assert report.compiled_bodies >= 5
+        assert report.jit_sites >= 10
+
+    def test_static_jit_map_covers_runtime_kinds(self):
+        """The kinds the runtime gate can observe (CompileCache records
+        "jax" and "fusion") must have statically predicted sites."""
+        report = analyze_paths([str(PACKAGE_DIR)])
+        assert {"jax", "fusion"} <= set(report.jit_site_kinds)
+
+    @pytest.mark.parametrize("rel", [
+        "serve/scheduler.py",       # batch fan-out: one device_get, no
+                                    # per-output np.asarray sync
+        "filters/llm.py",           # token streaming: device_get at the
+                                    # emit boundary, one fetch per step
+        "elements/filter.py",       # invoke/dispatch hot path
+        "filters/jax_backend.py",   # compile-miss path itself
+    ])
+    def test_fixed_hot_files_stay_clean(self, rel):
+        """Pinned regressions for the self-scan true positives fixed in
+        this change: each file must scan clean in isolation too."""
+        report = analyze_paths([str(PACKAGE_DIR / rel)])
+        assert report.findings == [], report.to_text()
+        assert report.hot_sites > 0
+
+    def test_trainer_suppression_is_reasoned(self):
+        """The one deliberate exception (one-shot optimizer init) is a
+        pragma'd suppression, not a silent pass."""
+        report = analyze_paths([str(PACKAGE_DIR / "trainers" /
+                                    "jax_trainer.py")])
+        assert report.findings == []
+        assert [f.rule for f in report.suppressed] == [RETRACE]
+
+
+# --------------------------------------------------------------------- CLI
+
+class TestCli:
+    def test_exit_zero_on_clean(self, tmp_path, capsys):
+        f = tmp_path / "clean.py"
+        f.write_text(CLEAN_HOST)
+        assert jitcheck_main([str(f)]) == 0
+        assert "0 finding(s)" in capsys.readouterr().out
+
+    def test_exit_one_on_findings(self, tmp_path, capsys):
+        f = tmp_path / "bad.py"
+        f.write_text(HOT_ITEM)
+        assert jitcheck_main([str(f)]) == 1
+        out = capsys.readouterr().out
+        assert HOST_SYNC in out and "bad.py:9" in out
+
+    def test_exit_two_on_missing_path(self, tmp_path, capsys):
+        assert jitcheck_main([str(tmp_path / "nope.py")]) == 2
+
+    def test_exit_two_on_bad_flag(self, capsys):
+        assert jitcheck_main(["--no-such-flag"]) == 2
+
+    def test_json_round_trip(self, tmp_path, capsys):
+        f = tmp_path / "bad.py"
+        f.write_text(HOT_ITEM)
+        assert jitcheck_main([str(f), "--json"]) == 1
+        data = json.loads(capsys.readouterr().out)
+        assert data["exit_code"] == 1
+        assert data["findings"][0]["rule"] == HOST_SYNC
+        assert data["findings"][0]["line"] == 9
+
+    def test_output_file_written(self, tmp_path, capsys):
+        f = tmp_path / "clean.py"
+        f.write_text(CLEAN_COMPILED)
+        out = tmp_path / "report" / "jitcheck.json"
+        assert jitcheck_main([str(f), "-o", str(out), "-q"]) == 0
+        data = json.loads(out.read_text())
+        assert data["compiled_bodies"] == 1
+
+    def test_min_hot_sites_guards_vacuous_scan(self, tmp_path, capsys):
+        f = tmp_path / "step.py"
+        f.write_text(CLEAN_COMPILED)          # compiled, but no hot path
+        assert jitcheck_main([str(f), "--min-hot-sites", "2",
+                              "--json"]) == 1
+        data = json.loads(capsys.readouterr().out)
+        assert [x["rule"] for x in data["findings"]] == [VACUOUS_COVERAGE]
+
+    def test_verbose_lists_suppressed(self, tmp_path, capsys):
+        src = HOT_ITEM.replace(
+            "return y.item()  ",
+            "return y.item()  # jitcheck: ok(probe boundary)")
+        f = tmp_path / "probe.py"
+        f.write_text(src)
+        assert jitcheck_main([str(f), "-v"]) == 0
+        assert "suppressed" in capsys.readouterr().out
+
+
+# ----------------------------------------- CompileCache canonicalization
+
+class TestSignatureCanon:
+    def test_canon_dtype_aliases(self):
+        for alias in ("<f4", "=f4", "single", "float32",
+                      np.float32, np.dtype("float32")):
+            assert canon_dtype(alias) == "float32"
+        assert canon_dtype(">i8") == "int64"
+
+    def test_canon_dtype_unknown_passthrough(self):
+        # dtypes NumPy can't parse (bfloat16 without ml_dtypes
+        # registration) keep their already-canonical string form
+        assert canon_dtype("bfloat16") == "bfloat16"
+
+    def test_alias_spellings_are_one_signature(self, tmp_path):
+        """'<f4' and 'float32' must collapse to ONE registry entry —
+        an alias entry would prewarm one jit-cache key and still miss
+        at invoke time: a double compile of the same program."""
+        cc = CompileCache(str(tmp_path / "cc"))
+        assert cc.record("jax", "m", (((8, 64), "<f4"),)) is True
+        assert cc.record("jax", "m", (((8, 64), "float32"),)) is False
+        assert cc.record("jax", "m", (((8, 64), "single"),)) is False
+        assert cc.signatures("jax", "m") == [((((8, 64), "float32"),), ())]
+        assert cc.entry_count() == 1
+
+    def test_canonical_form_survives_reload(self, tmp_path):
+        root = str(tmp_path / "cc")
+        CompileCache(root).record("fusion", "seg", (((4, 4), "=f8"),))
+        cc2 = CompileCache(root)
+        assert cc2.signatures("fusion", "seg") == [
+            ((((4, 4), "float64"),), ())]
+        assert cc2.record("fusion", "seg", (((4, 4), "double"),)) is False
+        assert cc2.kinds() == ["fusion"]
+
+
+# ------------------------------------------------- static↔runtime contract
+
+class TestStabilityContract:
+    def test_site_kind_buckets(self):
+        assert site_kind("nnstreamer_tpu/fusion/segment.py") == "fusion"
+        assert site_kind("nnstreamer_tpu/filters/jax_backend.py") == "jax"
+        assert site_kind("nnstreamer_tpu/trainers/jax_trainer.py") == \
+            "trainer"
+
+    def test_snapshot_and_steady(self):
+        class FakePipe:
+            def stats(self):
+                return {"f0": {"jit_hits": 5, "jit_misses": 1,
+                               "jit_recompiles": 0, "frames": 9},
+                        "sink": {"frames": 9}}
+        snap = jit_stat_snapshot(FakePipe())
+        assert set(snap) == {"f0"}          # only jit-bearing elements
+        assert snap["f0"] == {"jit_hits": 5, "jit_misses": 1,
+                              "jit_recompiles": 0}
+        assert steady_recompiles(snap) == 1
+
+    def test_contract_clean(self):
+        result = check_against_static({"jax": 3, "fusion": 1},
+                                      ["jax"], 0, strict=False)
+        assert result.ok
+
+    def test_contract_rejects_steady_recompiles(self):
+        with pytest.raises(AssertionError, match="frame path"):
+            check_against_static(["jax"], ["jax"], 2)
+
+    def test_contract_rejects_unpredicted_kind(self):
+        with pytest.raises(AssertionError, match="statically predicted"):
+            check_against_static(["jax"], ["mystery"], 0)
+
+    def test_contract_nonstrict_collects_problems(self):
+        result = check_against_static(["jax"], ["mystery"], 1,
+                                      strict=False)
+        assert not result.ok
+        assert len(result.problems) == 2
+        assert "BROKEN" in str(result)
+
+    def test_contract_accepts_report_object(self):
+        report = analyze_paths([str(PACKAGE_DIR / "filters")])
+        result = check_against_static(report, ["jax"], 0, strict=False)
+        assert result.ok
+
+
+# ------------------------------------------------- two-pass runtime gate
+
+class TestTwoPassStability:
+    def test_warm_second_pass_never_compiles(self, tmp_path):
+        """In-process miniature of `make jit-stability`: two fresh
+        pipelines over one persistent CompileCache — the second must
+        serve every frame without a frame-path compilation."""
+        from nnstreamer_tpu.fleet import cache as compile_cache
+        from nnstreamer_tpu.pipeline.parser import parse_launch
+        desc = ("tensortestsrc caps=other/tensors,format=static,"
+                "num_tensors=1,types=(string)float32,"
+                "dimensions=(string)64:8,framerate=(fraction)0/1 "
+                "num-buffers=3 ! "
+                "tensor_filter framework=jax model=zoo://mlp?dtype=float32 "
+                "name=jstab_f ! appsink name=jstab_out")
+        compile_cache.deactivate()
+        compile_cache.install(str(tmp_path / "cc"), export_env=False)
+        try:
+            snaps = []
+            for _ in range(2):
+                pipe = parse_launch(desc)
+                pipe.run(timeout=60.0)
+                snaps.append(jit_stat_snapshot(pipe))
+            cc = compile_cache.active()
+            assert cc is not None and cc.entry_count() >= 1
+            assert "jax" in cc.kinds()
+            assert steady_recompiles(snaps[1]) == 0, snaps
+        finally:
+            compile_cache.deactivate()
